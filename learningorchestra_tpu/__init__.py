@@ -1,0 +1,19 @@
+"""learningorchestra_tpu — a TPU-native data-science pipeline framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``StephanieGreenberg/learningOrchestra`` (reference mounted at
+``/root/reference``): a named-dataset catalog with CSV-URL ingestion and
+lineage metadata, column projection, field-type coercion, histograms, PCA and
+t-SNE visualization, and a model builder fitting five classifier families
+(lr/dt/rf/gb/nb) concurrently — exposed over REST with a Python client SDK.
+
+Where the reference dispatches compute to an Apache Spark JVM cluster and
+stores everything in MongoDB (reference docker-compose.yml:27-163), this
+framework keeps datasets as columnar shards in host RAM (with disk
+persistence) and runs all compute as jit-compiled JAX programs sharded over a
+``jax.sharding.Mesh`` — XLA collectives over ICI/DCN replace Spark shuffles.
+"""
+
+__version__ = "0.1.0"
+
+from learningorchestra_tpu.config import Settings, settings  # noqa: F401

@@ -1,0 +1,2 @@
+from learningorchestra_tpu.catalog.dataset import Dataset, Metadata  # noqa: F401
+from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: F401

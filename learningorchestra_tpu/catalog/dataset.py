@@ -1,0 +1,223 @@
+"""Named-dataset model: columnar data + the metadata/lineage contract.
+
+The reference's universal data plane is "one Mongo collection per file" where
+document ``_id: 0`` is a metadata doc ``{filename, url|parent_filename,
+time_created, finished, fields}`` and rows are ``_id: 1..N`` in CSV order
+(reference database.py:157-168,205-213; docs/database_api.md:3-77). The
+``finished`` flag flipping false→true is the system-wide async-completion
+signal the client polls (database.py:177-181), and ``parent_filename``
+records lineage for derived datasets.
+
+This module keeps that *contract* — names, metadata-doc shape, finished-flag
+semantics, row ``_id`` numbering — over a TPU-friendly *mechanism*: columns
+are contiguous numpy arrays (zero-copy into ``jax.numpy``/device shards)
+instead of per-row BSON documents.
+
+Upgrade over the reference: a mid-flight crash in the reference leaves
+``finished: false`` forever and clients poll infinitely (SURVEY.md §5); here
+metadata carries an ``error`` field that job runners set on failure so
+clients can fail fast.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+#: Columns are numpy arrays: numeric dtypes or ``object`` for strings/mixed.
+Columns = Dict[str, np.ndarray]
+
+
+@dataclass
+class Metadata:
+    """The ``_id: 0`` metadata document of a dataset."""
+
+    name: str
+    url: Optional[str] = None           # source URL for ingested datasets
+    parent: Optional[str] = None        # lineage: parent dataset name
+    time_created: str = ""
+    finished: bool = False
+    fields: List[str] = field(default_factory=list)
+    error: Optional[str] = None         # set when an async job failed
+    extra: Dict[str, Any] = field(default_factory=dict)  # e.g. model metrics
+
+    def __post_init__(self):
+        if not self.time_created:
+            # Same human-readable stamp style as the reference
+            # (database.py:206: time.strftime("%Y-%m-%d %H:%M:%S")).
+            self.time_created = time.strftime("%Y-%m-%d %H:%M:%S")
+
+    def to_doc(self) -> Dict[str, Any]:
+        """Render as the reference-shaped metadata document (``_id: 0``)."""
+        doc: Dict[str, Any] = {"_id": 0, "filename": self.name}
+        if self.url is not None:
+            doc["url"] = self.url
+        if self.parent is not None:
+            doc["parent_filename"] = self.parent
+        doc["time_created"] = self.time_created
+        doc["finished"] = self.finished
+        doc["fields"] = list(self.fields)
+        if self.error is not None:
+            doc["error"] = self.error
+        doc.update(self.extra)
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: Dict[str, Any]) -> "Metadata":
+        known = {"_id", "filename", "url", "parent_filename", "time_created",
+                 "finished", "fields", "error"}
+        return cls(
+            name=doc["filename"],
+            url=doc.get("url"),
+            parent=doc.get("parent_filename"),
+            time_created=doc.get("time_created", ""),
+            finished=bool(doc.get("finished", False)),
+            fields=list(doc.get("fields", [])),
+            error=doc.get("error"),
+            extra={k: v for k, v in doc.items() if k not in known},
+        )
+
+
+class Dataset:
+    """A named columnar dataset with reference-compatible row addressing.
+
+    Rows are addressed ``_id = 1..N`` in insertion order; ``_id = 0`` is the
+    metadata document. Appends are amortized O(1) via chunked column buffers
+    so streaming CSV ingestion never re-copies the whole table per chunk.
+    """
+
+    def __init__(self, metadata: Metadata, columns: Optional[Columns] = None):
+        self.metadata = metadata
+        # Guards _chunks/_consolidated: ingestion appends from a job thread
+        # while readers poll/consolidate the same dataset.
+        self._data_lock = threading.Lock()
+        self._chunks: List[Columns] = []
+        self._consolidated: Optional[Columns] = None
+        if columns:
+            self.append_columns(columns)
+
+    # -- writes -------------------------------------------------------------
+
+    def append_columns(self, columns: Columns) -> None:
+        """Append a chunk of rows given as equal-length column arrays."""
+        if not columns:
+            return
+        lengths = {len(v) for v in columns.values()}
+        if len(lengths) != 1:
+            raise ValueError(f"ragged column chunk: {lengths}")
+        cols = {k: np.asarray(v) for k, v in columns.items()}
+        if not self.metadata.fields:
+            self.metadata.fields = list(cols.keys())
+        elif list(cols.keys()) != self.metadata.fields:
+            missing = set(self.metadata.fields) - set(cols.keys())
+            extra = set(cols.keys()) - set(self.metadata.fields)
+            if missing or extra:
+                raise ValueError(
+                    f"chunk fields mismatch: missing={missing} extra={extra}")
+            cols = {k: cols[k] for k in self.metadata.fields}  # reorder
+        with self._data_lock:
+            self._chunks.append(cols)
+            self._consolidated = None
+
+    def append_rows(self, rows: List[Dict[str, Any]]) -> None:
+        """Append row dicts (used by result writers, e.g. predictions)."""
+        if not rows:
+            return
+        fields = self.metadata.fields or list(rows[0].keys())
+        cols: Columns = {}
+        for f in fields:
+            vals = [r.get(f) for r in rows]
+            arr = np.asarray(vals)
+            if arr.dtype.kind == "U":  # keep strings as object for None-safety
+                arr = np.asarray(vals, dtype=object)
+            cols[f] = arr
+        self.append_columns(cols)
+
+    def set_column(self, name: str, values: np.ndarray) -> None:
+        """Replace/add a full column (used by type coercion)."""
+        values = np.asarray(values)
+        if self.num_rows and len(values) != self.num_rows:
+            raise ValueError(
+                f"column length {len(values)} != num_rows {self.num_rows}")
+        cols = dict(self.columns)
+        cols[name] = values
+        if name not in self.metadata.fields:
+            self.metadata.fields.append(name)
+        with self._data_lock:
+            self._chunks = [{f: cols[f] for f in self.metadata.fields}]
+            self._consolidated = None
+
+    # -- reads --------------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        with self._data_lock:
+            return sum(len(next(iter(c.values()))) for c in self._chunks)
+
+    @property
+    def columns(self) -> Columns:
+        """Consolidated column arrays (cached; invalidated by appends)."""
+        with self._data_lock:
+            if self._consolidated is None:
+                if not self._chunks:
+                    self._consolidated = {}
+                elif len(self._chunks) == 1:
+                    self._consolidated = self._chunks[0]
+                else:
+                    fields = self.metadata.fields
+                    self._consolidated = {
+                        f: _concat([c[f] for c in self._chunks])
+                        for f in fields}
+                    self._chunks = [self._consolidated]
+            return self._consolidated
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def rows(self, indices: np.ndarray) -> List[Dict[str, Any]]:
+        """Materialize row documents (``_id`` = index+1) for the given
+        0-based row indices — the read-back path (reference database.py:36-48)."""
+        cols = self.columns
+        out = []
+        for i in indices:
+            doc = {"_id": int(i) + 1}
+            for f in self.metadata.fields:
+                doc[f] = _pyval(cols[f][i])
+            out.append(doc)
+        return out
+
+    def numeric_matrix(self, fields: Optional[List[str]] = None) -> np.ndarray:
+        """Dense float32 design matrix over the given (default: all numeric)
+        fields — the hand-off point from catalog to the TPU mesh."""
+        cols = self.columns
+        if fields is None:
+            fields = [f for f in self.metadata.fields
+                      if cols[f].dtype.kind in "ifub"]
+        mats = []
+        for f in fields:
+            c = cols[f]
+            if c.dtype.kind not in "ifub":
+                raise TypeError(f"field {f!r} is not numeric (dtype {c.dtype})")
+            mats.append(np.asarray(c, dtype=np.float32))
+        if not mats:
+            return np.zeros((self.num_rows, 0), dtype=np.float32)
+        return np.stack(mats, axis=1)
+
+
+def _concat(arrays: List[np.ndarray]) -> np.ndarray:
+    if any(a.dtype == object for a in arrays):
+        arrays = [a.astype(object) for a in arrays]
+    return np.concatenate(arrays)
+
+
+def _pyval(v):
+    """numpy scalar → plain Python (JSON-serializable) value."""
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float) and v != v:  # NaN → null in JSON
+        return None
+    return v

@@ -1,0 +1,207 @@
+"""Streaming CSV ingestion: URL → columnar dataset.
+
+Reproduces the reference's 3-stage producer-consumer ingest pipeline —
+downloader thread → row-transformer thread → DB-writer thread linked by two
+bounded Queue(1000)s, inserting one Mongo document per row
+(reference database.py:133-216) — re-designed columnar:
+
+- stage 1 (thread): HTTP-stream the CSV body into a bounded byte-chunk queue
+  (backpressure == the reference's bounded queues);
+- stage 2 (caller thread): a file-like adapter over that queue feeds a chunked
+  CSV parser (native C++ parser when built, pandas otherwise) producing
+  64k-row *column chunks* appended to the dataset — thousands of times fewer
+  append operations than the reference's per-row ``insert_one``
+  (database.py:176), which SURVEY.md §3.1 identifies as its ingest ceiling.
+
+URL validation matches the reference's sniff-first-line check rejecting
+HTML/JSON payloads (database.py:183-197). Type handling matches the
+reference's ``tratament_file`` semantics (database.py:156-169): numeric
+strings become numbers, empty strings become null.
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.config import settings as global_settings
+
+
+class InvalidCsvUrl(ValueError):
+    pass
+
+
+_CHUNK_BYTES = 1 << 20          # 1 MiB download chunks
+_QUEUE_DEPTH = 64               # bounded: ~64 MiB in flight max
+
+
+class _QueueReader(io.RawIOBase):
+    """File-like view over a bounded queue of byte chunks (the pipeline
+    coupling; None sentinel = EOF, an Exception instance = producer error)."""
+
+    def __init__(self, q: "queue.Queue"):
+        self._q = q
+        self._buf = b""
+        self._eof = False
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        while not self._buf and not self._eof:
+            item = self._q.get()
+            if item is None:
+                self._eof = True
+            elif isinstance(item, Exception):
+                self._eof = True
+                raise item
+            else:
+                self._buf = item
+        n = min(len(b), len(self._buf))
+        b[:n] = self._buf[:n]
+        self._buf = self._buf[n:]
+        return n
+
+
+def _sniff_header(first_chunk: bytes, url: str) -> None:
+    """Reject obviously-non-CSV payloads, as the reference does by checking
+    the first line for HTML/JSON markers (database.py:183-197)."""
+    head = first_chunk.lstrip()[:256].lower()
+    if head.startswith((b"<!doctype", b"<html", b"{", b"[")):
+        raise InvalidCsvUrl(f"url does not look like CSV: {url}")
+
+
+def _open_url_stream(url: str, timeout: float):
+    """Yield byte chunks from a URL (http(s)://) or local file (file:// or
+    bare path — used by tests and the bench harness)."""
+    if url.startswith(("http://", "https://")):
+        import requests
+
+        resp = requests.get(url, stream=True, timeout=timeout)
+        resp.raise_for_status()
+        return resp.iter_content(chunk_size=_CHUNK_BYTES)
+    path = url[len("file://"):] if url.startswith("file://") else url
+
+    def file_chunks() -> Iterator[bytes]:
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(_CHUNK_BYTES)
+                if not chunk:
+                    return
+                yield chunk
+
+    return file_chunks()
+
+
+def ingest_csv_url(store: DatasetStore, name: str, url: str,
+                   cfg=None) -> None:
+    """Synchronous core of ingestion; run under JobManager for async.
+
+    The dataset must already exist with ``finished=False`` (created by the
+    API layer before returning 201, mirroring the reference's metadata-first
+    insert at database.py:205-213).
+    """
+    cfg = cfg or global_settings
+    ds = store.get(name)
+
+    chunks_q: "queue.Queue" = queue.Queue(maxsize=_QUEUE_DEPTH)
+    cancel = threading.Event()
+
+    def _put(item) -> bool:
+        """Cancellation-aware put; returns False if consumer gave up."""
+        while not cancel.is_set():
+            try:
+                chunks_q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def downloader() -> None:
+        try:
+            first = True
+            for chunk in _open_url_stream(url, cfg.download_timeout):
+                if first:
+                    _sniff_header(chunk, url)
+                    first = False
+                if not _put(chunk):
+                    return
+            _put(None)
+        except Exception as exc:  # noqa: BLE001 — forwarded to consumer
+            _put(exc)
+
+    t = threading.Thread(target=downloader, daemon=True, name="lo-ingest-dl")
+    t.start()
+
+    reader = io.BufferedReader(_QueueReader(chunks_q), buffer_size=_CHUNK_BYTES)
+    try:
+        for cols in parse_csv_chunks(reader, cfg.ingest_chunk_rows, cfg):
+            ds.append_columns(cols)
+    finally:
+        # Unblock and reap the downloader even when the parser raised
+        # mid-stream; otherwise it parks forever on the bounded queue
+        # holding the HTTP connection and buffered chunks.
+        cancel.set()
+        while True:
+            try:
+                chunks_q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5.0)
+    store.finish(name)
+
+
+def parse_csv_chunks(fileobj, chunk_rows: int, cfg=None):
+    """Chunked CSV → column-dict iterator. Uses the native C++ tokenizer when
+    available (catalog.native), else pandas."""
+    cfg = cfg or global_settings
+    if cfg.use_native_csv:
+        from learningorchestra_tpu.catalog import native
+
+        if native.available():
+            yield from native.parse_csv_chunks(fileobj, chunk_rows)
+            return
+    yield from _parse_csv_pandas(fileobj, chunk_rows)
+
+
+def _parse_csv_pandas(fileobj, chunk_rows: int):
+    import pandas as pd
+
+    text = io.TextIOWrapper(fileobj, encoding="utf-8", errors="replace")
+    for frame in pd.read_csv(text, chunksize=chunk_rows):
+        yield frame_to_columns(frame)
+
+
+def frame_to_columns(frame) -> dict:
+    """pandas DataFrame → {name: np.ndarray} with reference-compatible type
+    semantics: numeric columns stay numeric (floats that are integral stay
+    int64 per pandas inference), strings are object arrays, missing → None
+    for strings / NaN for numerics (reference database.py:156-169)."""
+    cols = {}
+    for cname in frame.columns:
+        s = frame[cname]
+        if s.dtype == object:
+            arr = s.to_numpy(dtype=object)
+            arr = np.array([None if (v is None or (isinstance(v, float) and v != v)
+                                     or v == "") else v
+                            for v in arr], dtype=object)
+        else:
+            arr = s.to_numpy()
+        cols[str(cname)] = arr
+    return cols
+
+
+def ingest_csv_text(store: DatasetStore, name: str, text: str,
+                    cfg=None) -> None:
+    """Ingest from an in-memory CSV string (tests / local tooling)."""
+    cfg = cfg or global_settings
+    ds = store.get(name)
+    reader = io.BytesIO(text.encode("utf-8"))
+    for cols in parse_csv_chunks(reader, cfg.ingest_chunk_rows, cfg):
+        ds.append_columns(cols)
+    store.finish(name)

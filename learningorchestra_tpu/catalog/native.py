@@ -1,0 +1,145 @@
+"""ctypes binding to the native C++ CSV tokenizer (``native/csv_parser.cpp``).
+
+The reference's native horsepower lived in the external Spark JVM
+(SURVEY.md §2); this framework's native tier is first-party C++. The parser
+tokenizes CSV bytes into per-column buffers with SIMD-friendly scanning and
+returns numeric columns as contiguous float64 buffers consumed zero-copy by
+numpy. Falls back to pandas when the shared library has not been built
+(``make -C native`` builds it; tests cover both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+_LIB_NAMES = ("libcsv_parser.so",)
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _lib_path() -> Optional[str]:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for name in _LIB_NAMES:
+        for sub in ("native", "native/build"):
+            p = os.path.join(root, sub, name)
+            if os.path.isfile(p):
+                return p
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = _lib_path()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.lo_csv_parse.restype = ctypes.c_void_p
+        lib.lo_csv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
+        lib.lo_csv_ncols.restype = ctypes.c_int
+        lib.lo_csv_ncols.argtypes = [ctypes.c_void_p]
+        lib.lo_csv_nrows.restype = ctypes.c_long
+        lib.lo_csv_nrows.argtypes = [ctypes.c_void_p]
+        lib.lo_csv_col_name.restype = ctypes.c_char_p
+        lib.lo_csv_col_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.lo_csv_col_is_numeric.restype = ctypes.c_int
+        lib.lo_csv_col_is_numeric.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.lo_csv_col_numeric.restype = ctypes.POINTER(ctypes.c_double)
+        lib.lo_csv_col_numeric.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.lo_csv_cell_str.restype = ctypes.c_char_p
+        lib.lo_csv_cell_str.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_long]
+        lib.lo_csv_free.restype = None
+        lib.lo_csv_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_csv_bytes(data: bytes, has_header: bool = True) -> dict:
+    """Parse a complete CSV byte buffer into {name: np.ndarray}."""
+    lib = _load()
+    assert lib is not None, "native parser not built"
+    handle = lib.lo_csv_parse(data, len(data), 1 if has_header else 0)
+    if not handle:
+        raise ValueError("native CSV parse failed")
+    try:
+        ncols = lib.lo_csv_ncols(handle)
+        nrows = lib.lo_csv_nrows(handle)
+        out = {}
+        for c in range(ncols):
+            name = lib.lo_csv_col_name(handle, c).decode("utf-8")
+            if lib.lo_csv_col_is_numeric(handle, c):
+                ptr = lib.lo_csv_col_numeric(handle, c)
+                arr = np.ctypeslib.as_array(ptr, shape=(nrows,)).copy()
+                # Integral float columns → int64, matching pandas/reference
+                # inference (database.py:163-168 float→int when integral).
+                finite = arr[~np.isnan(arr)]
+                if finite.size and np.all(finite == np.floor(finite)) \
+                        and not np.isnan(arr).any():
+                    arr = arr.astype(np.int64)
+                out[name] = arr
+            else:
+                vals = []
+                for r in range(nrows):
+                    cell = lib.lo_csv_cell_str(handle, c, r)
+                    s = cell.decode("utf-8") if cell is not None else None
+                    vals.append(None if s == "" or s is None else s)
+                out[name] = np.array(vals, dtype=object)
+        return out
+    finally:
+        lib.lo_csv_free(handle)
+
+
+def _record_split(block: bytes) -> int:
+    """Last newline index that terminates a complete CSV *record* — i.e. a
+    newline at even quote parity, so RFC-4180 quoted fields containing
+    embedded newlines are never cut mid-record. Returns -1 if none."""
+    cut = -1
+    in_quotes = False
+    for i, b in enumerate(block):
+        if b == 0x22:  # '"' — doubled quotes inside fields flip twice: no-op
+            in_quotes = not in_quotes
+        elif b == 0x0A and not in_quotes:
+            cut = i
+    return cut
+
+
+def parse_csv_chunks(fileobj, chunk_rows: int) -> Iterator[dict]:
+    """Chunked parse over a stream: reads record-aligned byte blocks and
+    feeds them to the native parser, re-attaching the header to every block."""
+    header = fileobj.readline()
+    if not header:
+        return
+    approx_row = max(len(header), 32)
+    target = max(chunk_rows * approx_row, 1 << 20)
+    carry = b""
+    while True:
+        block = fileobj.read(target)
+        if not block:
+            if carry.strip():
+                yield parse_csv_bytes(header + carry)
+            return
+        block = carry + block
+        cut = _record_split(block)
+        if cut < 0:
+            carry = block
+            continue
+        carry = block[cut + 1:]
+        chunk = block[:cut + 1]
+        if chunk.strip():
+            yield parse_csv_bytes(header + chunk)

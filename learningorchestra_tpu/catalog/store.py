@@ -1,0 +1,281 @@
+"""DatasetStore — the catalog: thread-safe named-dataset registry + queries
++ disk persistence.
+
+Replaces the reference's MongoDB replica set as the universal data plane
+(reference docker-compose.yml:27-91). The API surface mirrors what the 7
+microservices actually used Mongo for (SURVEY.md §1/L4):
+
+- collection-per-file naming, create/get/delete/list
+  (reference database.py:94-130),
+- paginated, filtered, ``_id``-sorted reads (database.py:36-48,107-111),
+- metadata read/update incl. the ``finished`` flip (database.py:177-181),
+- value-count aggregation for histograms (histogram.py:49-74) — here a
+  vectorized method instead of a Mongo ``$group`` pipeline.
+
+Queries support the Mongo-query subset the reference's docs exercise
+(equality and ``$gt/$gte/$lt/$lte/$ne/$in``) evaluated vectorized over
+columns. Persistence is parquet + metadata.json per dataset under
+``settings.store_root`` — the durability tier replacing Mongo volumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from learningorchestra_tpu.catalog.dataset import Columns, Dataset, Metadata
+from learningorchestra_tpu.config import Settings, settings as global_settings
+
+
+class DatasetNotFound(KeyError):
+    pass
+
+
+class DatasetExists(ValueError):
+    pass
+
+
+class DatasetStore:
+    """In-memory catalog of named datasets with optional disk persistence."""
+
+    def __init__(self, cfg: Optional[Settings] = None):
+        self.cfg = cfg or global_settings
+        self._lock = threading.RLock()
+        self._datasets: Dict[str, Dataset] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def create(self, name: str, *, url: Optional[str] = None,
+               parent: Optional[str] = None, finished: bool = False,
+               columns: Optional[Columns] = None,
+               extra: Optional[Dict[str, Any]] = None) -> Dataset:
+        with self._lock:
+            if name in self._datasets:
+                # Reference returns 409 on duplicate filename
+                # (database_api_image/server.py:44-48).
+                raise DatasetExists(name)
+            meta = Metadata(name=name, url=url, parent=parent,
+                            finished=finished, extra=dict(extra or {}))
+            ds = Dataset(meta, columns)
+            self._datasets[name] = ds
+            return ds
+
+    def get(self, name: str) -> Dataset:
+        with self._lock:
+            try:
+                return self._datasets[name]
+            except KeyError:
+                raise DatasetNotFound(name) from None
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._datasets
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            if name not in self._datasets:
+                raise DatasetNotFound(name)
+            del self._datasets[name]
+        path = self._path(name)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._datasets)
+
+    # -- metadata / completion protocol -------------------------------------
+
+    def metadata_docs(self) -> List[Dict[str, Any]]:
+        """All metadata docs — the reference's ``read_files_descriptor``
+        listing (database_api_image/server.py:79-87)."""
+        with self._lock:
+            return [d.metadata.to_doc() for d in self._datasets.values()]
+
+    def finish(self, name: str, **extra) -> None:
+        """Flip ``finished`` true and persist — the commit point
+        (reference database.py:177-181, projection.py:113-123)."""
+        ds = self.get(name)
+        ds.metadata.extra.update(extra)
+        ds.metadata.finished = True
+        if self.cfg.persist:
+            self.save(name)
+
+    def fail(self, name: str, error: str) -> None:
+        """Record job failure so pollers don't spin forever (fixes the
+        reference's finished:false-forever failure mode, SURVEY.md §5)."""
+        ds = self.get(name)
+        ds.metadata.error = error
+        ds.metadata.finished = True
+        if self.cfg.persist:
+            self.save(name)
+
+    # -- reads ---------------------------------------------------------------
+
+    def read(self, name: str, skip: int = 0, limit: int = 10,
+             query: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
+        """Paginated filtered read, ``_id``-sorted, metadata doc included when
+        it matches — mirrors ``DatabaseApi.read_file``
+        (reference database.py:36-48, server.py:62-76)."""
+        ds = self.get(name)
+        query = query or {}
+        docs: List[Dict[str, Any]] = []
+        meta_doc = ds.metadata.to_doc()
+        n_meta = 1 if _doc_matches(meta_doc, query) else 0
+        if n_meta and skip == 0:
+            docs.append(meta_doc)
+        idx = self._query_indices(ds, query)
+        # Apply skip/limit on indices BEFORE materializing row dicts (the
+        # reference pushed skip/limit into the Mongo cursor,
+        # database.py:107-111).
+        row_skip = max(0, skip - n_meta)
+        idx = idx[row_skip:row_skip + limit - len(docs)]
+        docs.extend(ds.rows(idx))
+        return docs
+
+    def _query_indices(self, ds: Dataset, query: Dict[str, Any]) -> np.ndarray:
+        n = ds.num_rows
+        mask = np.ones(n, dtype=bool)
+        for field, cond in query.items():
+            if field == "_id":
+                vals = np.arange(1, n + 1)
+            elif field in ds.columns:
+                vals = ds.columns[field]
+            else:
+                mask[:] = False
+                break
+            mask &= _eval_cond(vals, cond)
+        return np.nonzero(mask)[0]
+
+    # -- aggregation ---------------------------------------------------------
+
+    def value_counts(self, name: str, field: str) -> Dict[Any, int]:
+        """Per-value counts of a column — the reference's histogram
+        aggregation ``[{"$group": {"_id": "$field", "count": {"$sum": 1}}}]``
+        (histogram.py:49-74), vectorized."""
+        ds = self.get(name)
+        col = ds.columns[field]
+        if col.dtype == object:
+            null_mask = np.array([v is None for v in col], dtype=bool)
+            vals = col[~null_mask].astype(str)
+        else:
+            null_mask = (np.isnan(col) if col.dtype.kind == "f"
+                         else np.zeros(len(col), dtype=bool))
+            vals = col[~null_mask]
+        uniq, counts = np.unique(vals, return_counts=True)
+        out: Dict[Any, int] = {}
+        for u, c in zip(uniq, counts):
+            u = u.item() if isinstance(u, np.generic) else u
+            out[u] = int(c)
+        n_null = int(null_mask.sum())
+        if n_null:
+            # Missing values bucket under the None key (Mongo $group keeps
+            # null as a distinct group key; JSON renders it as "null").
+            out[None] = n_null
+        return out
+
+    # -- persistence ---------------------------------------------------------
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.cfg.store_root, name)
+
+    def save(self, name: str) -> None:
+        """Write dataset as parquet + metadata.json under store_root."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        ds = self.get(name)
+        path = self._path(name)
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(ds.metadata.to_doc(), f, default=str)
+        if ds.num_rows:
+            cols = ds.columns
+            arrays, names = [], []
+            for fname in ds.metadata.fields:
+                arr = cols[fname]
+                if arr.dtype == object:
+                    arrays.append(pa.array([None if v is None else str(v)
+                                            for v in arr]))
+                else:
+                    arrays.append(pa.array(arr))
+                names.append(fname)
+            pq.write_table(pa.table(arrays, names=names),
+                           os.path.join(path, "data.parquet"))
+
+    def load(self, name: str) -> Dataset:
+        """Load one persisted dataset into the catalog."""
+        import pyarrow.parquet as pq
+
+        path = self._path(name)
+        meta_path = os.path.join(path, "metadata.json")
+        if not os.path.isfile(meta_path):
+            raise DatasetNotFound(name)
+        with open(meta_path) as f:
+            meta = Metadata.from_doc(json.load(f))
+        columns: Columns = {}
+        data_path = os.path.join(path, "data.parquet")
+        if os.path.isfile(data_path):
+            table = pq.read_table(data_path)
+            for fname in table.column_names:
+                arr = table.column(fname).to_numpy(zero_copy_only=False)
+                columns[fname] = arr
+        ds = Dataset(meta, columns or None)
+        with self._lock:
+            self._datasets[name] = ds
+        return ds
+
+    def load_all(self) -> List[str]:
+        """Recover the catalog from disk at startup (crash resume)."""
+        root = self.cfg.store_root
+        loaded = []
+        if os.path.isdir(root):
+            for name in sorted(os.listdir(root)):
+                if os.path.isfile(os.path.join(root, name, "metadata.json")):
+                    self.load(name)
+                    loaded.append(name)
+        return loaded
+
+
+# -- query evaluation --------------------------------------------------------
+
+_OPS = {
+    "$gt": lambda v, x: v > x,
+    "$gte": lambda v, x: v >= x,
+    "$lt": lambda v, x: v < x,
+    "$lte": lambda v, x: v <= x,
+    "$ne": lambda v, x: v != x,
+    "$eq": lambda v, x: v == x,
+    "$in": lambda v, x: np.isin(v, x),
+}
+
+
+def _eval_cond(vals: np.ndarray, cond: Any) -> np.ndarray:
+    if isinstance(cond, dict):
+        mask = np.ones(len(vals), dtype=bool)
+        for op, operand in cond.items():
+            if op not in _OPS:
+                raise ValueError(f"unsupported query operator: {op}")
+            with np.errstate(invalid="ignore"):
+                mask &= np.asarray(_OPS[op](vals, operand), dtype=bool)
+        return mask
+    with np.errstate(invalid="ignore"):
+        return np.asarray(vals == cond, dtype=bool)
+
+
+def _doc_matches(doc: Dict[str, Any], query: Dict[str, Any]) -> bool:
+    for field, cond in query.items():
+        if field not in doc:
+            return False
+        val = np.asarray([doc[field]], dtype=object)
+        try:
+            if not _eval_cond(val, cond)[0]:
+                return False
+        except TypeError:
+            return False
+    return True

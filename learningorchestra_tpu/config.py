@@ -1,0 +1,102 @@
+"""Typed configuration for the framework.
+
+The reference configures everything through env vars scattered across
+Dockerfiles and docker-compose service blocks with no validation layer
+(reference docker-compose.yml:23-25,188-192; model_builder_image/Dockerfile:8-13).
+Here a single dataclass holds every knob, reads the environment once, and is
+importable everywhere — the "typed pydantic-style settings" upgrade called for
+in SURVEY.md §7 without taking a pydantic dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env(name: str, default, cast=None):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if cast is None:
+        cast = type(default) if default is not None else str
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclass
+class Settings:
+    """All framework knobs, env-overridable with the ``LO_TPU_`` prefix."""
+
+    # --- storage -----------------------------------------------------------
+    #: On-disk root for persisted datasets (parquet + metadata.json). The
+    #: catalog always keeps hot data in host RAM; this is the durability tier
+    #: replacing the reference's MongoDB volumes (docker-compose.yml:335-340).
+    store_root: str = field(
+        default_factory=lambda: _env("LO_TPU_STORE_ROOT", "/tmp/lo_tpu_store")
+    )
+    #: Persist datasets to disk on every commit (finished-flip).
+    persist: bool = field(default_factory=lambda: _env("LO_TPU_PERSIST", True, bool))
+
+    # --- ingestion ---------------------------------------------------------
+    #: CSV ingest chunk size (rows) for the streaming loader. Replaces the
+    #: reference's 3-thread/queue(1000) row-at-a-time pipeline
+    #: (database_api_image/database.py:133-216) with columnar chunks.
+    ingest_chunk_rows: int = field(
+        default_factory=lambda: _env("LO_TPU_INGEST_CHUNK_ROWS", 65536)
+    )
+    #: HTTP timeout for CSV downloads, seconds.
+    download_timeout: float = field(
+        default_factory=lambda: _env("LO_TPU_DOWNLOAD_TIMEOUT", 60.0)
+    )
+    #: Use the native C++ CSV parser when its shared library is built.
+    use_native_csv: bool = field(
+        default_factory=lambda: _env("LO_TPU_USE_NATIVE_CSV", True, bool)
+    )
+
+    # --- mesh / parallelism ------------------------------------------------
+    #: Mesh axis names. "data" shards rows (the reference's Spark partitioning
+    #: axis, SURVEY.md §2 parallelism #1); "model" shards features/params.
+    data_axis: str = "data"
+    model_axis: str = "model"
+    #: Optional forced mesh shape "D,M"; empty = use all local devices on data.
+    mesh_shape: str = field(default_factory=lambda: _env("LO_TPU_MESH_SHAPE", ""))
+
+    # --- serving -----------------------------------------------------------
+    #: Single service port. The reference runs 7 Flask apps on ports
+    #: 5000-5006 (client __init__.py:56-333); here one server hosts all
+    #: routers; per-service ports are emulated by path prefixes.
+    port: int = field(default_factory=lambda: _env("LO_TPU_PORT", 5000))
+    host: str = field(default_factory=lambda: _env("LO_TPU_HOST", "127.0.0.1"))
+    #: Page-size cap for dataset reads; reference hard-caps at 20
+    #: (database_api_image/server.py:28,69-70).
+    read_limit_cap: int = field(default_factory=lambda: _env("LO_TPU_READ_CAP", 20))
+    #: Directory where viz services write PNGs (reference volumes
+    #: tsne:/images, pca:/images, docker-compose.yml:289-290).
+    image_root: str = field(
+        default_factory=lambda: _env("LO_TPU_IMAGE_ROOT", "/tmp/lo_tpu_images")
+    )
+
+    # --- training ----------------------------------------------------------
+    #: Max concurrently running model fits (reference: 5 classifiers through
+    #: a ThreadPoolExecutor + Spark FAIR pool, model_builder.py:95,160-176).
+    max_concurrent_fits: int = field(
+        default_factory=lambda: _env("LO_TPU_MAX_CONCURRENT_FITS", 5)
+    )
+    #: Allow user-supplied preprocessing code via exec(). The reference does
+    #: this unconditionally (model_builder.py:145-150); here it is opt-in and
+    #: off by default — the declarative preprocessing API is the default path.
+    allow_exec_preprocessing: bool = field(
+        default_factory=lambda: _env("LO_TPU_ALLOW_EXEC", False, bool)
+    )
+
+    def replace(self, **kw) -> "Settings":
+        new = Settings()
+        for f in fields(self):
+            setattr(new, f.name, kw.get(f.name, getattr(self, f.name)))
+        return new
+
+
+#: Process-global settings instance. Tests construct their own.
+settings = Settings()

@@ -1,0 +1,97 @@
+"""Async job manager — the framework's completion/failure protocol.
+
+The reference's async model: an HTTP request returns 201 immediately, work
+continues on daemon threads, and completion is signaled *only* by the
+dataset's metadata ``finished`` flag flipping true, which clients poll every
+3 s (reference database.py:199-216, client __init__.py:14-32). There is no
+failure signal — a crashed job leaves ``finished: false`` forever
+(SURVEY.md §5).
+
+This manager keeps the same observable contract (request returns, poll the
+metadata) and adds: a job registry with status/timing, guaranteed terminal
+state (``finished`` always flips, with ``error`` set on failure), and a
+bounded worker pool replacing unbounded daemon-thread spawning.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class JobRecord:
+    job_id: str
+    dataset: str
+    kind: str
+    status: str = "running"          # running | done | failed
+    error: Optional[str] = None
+    started_at: float = field(default_factory=time.time)
+    finished_at: Optional[float] = None
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.job_id, "dataset": self.dataset, "kind": self.kind,
+            "status": self.status, "error": self.error,
+            "started_at": self.started_at, "finished_at": self.finished_at,
+            "duration": (self.finished_at or time.time()) - self.started_at,
+        }
+
+
+class JobManager:
+    """Bounded-pool async job runner with per-dataset failure recording."""
+
+    def __init__(self, store, max_workers: int = 8):
+        self.store = store
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="lo-job")
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, JobRecord] = {}
+        self._seq = 0
+
+    def submit(self, kind: str, dataset: str,
+               fn: Callable[[], Any]) -> JobRecord:
+        """Run ``fn`` async. On exception, mark the dataset failed in the
+        catalog (finished=True + error) so pollers terminate."""
+        with self._lock:
+            self._seq += 1
+            rec = JobRecord(job_id=f"{kind}-{self._seq}", dataset=dataset,
+                            kind=kind)
+            self._jobs[rec.job_id] = rec
+
+        def run():
+            try:
+                fn()
+                rec.status = "done"
+            except Exception as exc:  # noqa: BLE001 — job boundary
+                rec.status = "failed"
+                rec.error = f"{type(exc).__name__}: {exc}"
+                traceback.print_exc()
+                try:
+                    self.store.fail(dataset, rec.error)
+                except Exception:
+                    pass
+            finally:
+                rec.finished_at = time.time()
+
+        future: Future = self._pool.submit(run)
+        rec._future = future  # type: ignore[attr-defined]
+        return rec
+
+    def wait_all(self, timeout: Optional[float] = None) -> None:
+        """Block until all submitted jobs reach a terminal state (tests)."""
+        deadline = None if timeout is None else time.time() + timeout
+        for rec in list(self._jobs.values()):
+            fut = getattr(rec, "_future", None)
+            if fut is not None:
+                remaining = None if deadline is None else max(
+                    0.0, deadline - time.time())
+                fut.result(timeout=remaining)
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.to_doc() for r in self._jobs.values()]

@@ -1,0 +1,35 @@
+"""Test configuration.
+
+Forces JAX onto a simulated 8-device CPU mesh — the TPU-native analogue of
+"multi-node without a real cluster" (SURVEY.md §4): every sharding/collective
+test runs against real XLA partitioning semantics with no TPU attached. Must
+run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    from learningorchestra_tpu.config import Settings
+
+    s = Settings()
+    s.store_root = str(tmp_path / "store")
+    s.image_root = str(tmp_path / "images")
+    s.persist = False
+    return s
+
+
+@pytest.fixture()
+def store(cfg):
+    from learningorchestra_tpu.catalog.store import DatasetStore
+
+    return DatasetStore(cfg)

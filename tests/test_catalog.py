@@ -1,0 +1,168 @@
+"""Catalog unit tests: dataset contract, store CRUD/queries, persistence.
+
+Covers the reference's data-plane behaviors (SURVEY.md §1/L4): metadata doc
+shape, _id numbering, finished-flip, lineage, paginated filtered reads, and
+the duplicate-name conflict."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.catalog.dataset import Dataset, Metadata
+from learningorchestra_tpu.catalog.store import (
+    DatasetExists, DatasetNotFound, DatasetStore)
+
+
+def _mkcols(n=5):
+    return {
+        "a": np.arange(n, dtype=np.int64),
+        "b": np.arange(n, dtype=np.float64) * 1.5,
+        "name": np.array([f"r{i}" for i in range(n)], dtype=object),
+    }
+
+
+def test_metadata_doc_shape():
+    ds = Dataset(Metadata(name="ds1", url="http://x/d.csv"), _mkcols())
+    doc = ds.metadata.to_doc()
+    assert doc["_id"] == 0
+    assert doc["filename"] == "ds1"
+    assert doc["url"] == "http://x/d.csv"
+    assert doc["finished"] is False
+    assert doc["fields"] == ["a", "b", "name"]
+    assert doc["time_created"]
+
+
+def test_lineage_parent():
+    ds = Dataset(Metadata(name="child", parent="parent_ds"))
+    assert ds.metadata.to_doc()["parent_filename"] == "parent_ds"
+
+
+def test_row_ids_start_at_one():
+    ds = Dataset(Metadata(name="d"), _mkcols(3))
+    rows = ds.rows(np.arange(3))
+    assert [r["_id"] for r in rows] == [1, 2, 3]
+    assert rows[0]["name"] == "r0"
+
+
+def test_append_chunks_consolidate():
+    ds = Dataset(Metadata(name="d"))
+    ds.append_columns(_mkcols(4))
+    ds.append_columns(_mkcols(3))
+    assert ds.num_rows == 7
+    assert len(ds.column("a")) == 7
+    assert ds.column("a")[4] == 0
+
+
+def test_append_rows_and_numeric_matrix():
+    ds = Dataset(Metadata(name="d"))
+    ds.append_rows([{"x": 1, "y": 2.0}, {"x": 3, "y": 4.0}])
+    mat = ds.numeric_matrix()
+    assert mat.shape == (2, 2)
+    assert mat.dtype == np.float32
+    assert mat[1, 0] == 3.0
+
+
+def test_store_create_conflict_and_delete(store):
+    store.create("d", columns=_mkcols())
+    with pytest.raises(DatasetExists):
+        store.create("d")
+    store.delete("d")
+    with pytest.raises(DatasetNotFound):
+        store.get("d")
+
+
+def test_read_includes_metadata_and_paginates(store):
+    store.create("d", columns=_mkcols(10), finished=True)
+    docs = store.read("d", skip=0, limit=3)
+    assert docs[0]["_id"] == 0  # metadata doc first
+    assert [d["_id"] for d in docs[1:]] == [1, 2]
+    docs = store.read("d", skip=3, limit=3)
+    assert [d["_id"] for d in docs] == [3, 4, 5]
+
+
+def test_read_query_operators(store):
+    store.create("d", columns=_mkcols(10), finished=True)
+    docs = store.read("d", limit=20, query={"a": {"$gte": 7}})
+    assert [d["a"] for d in docs] == [7, 8, 9]
+    docs = store.read("d", limit=20, query={"name": "r3"})
+    assert len(docs) == 1 and docs[0]["a"] == 3
+    docs = store.read("d", limit=20, query={"_id": {"$in": [1, 4]}})
+    assert [d["_id"] for d in docs] == [1, 4]
+
+
+def test_finish_and_fail_protocol(store):
+    store.create("d", columns=_mkcols())
+    assert store.get("d").metadata.finished is False
+    store.finish("d", note="ok")
+    meta = store.get("d").metadata
+    assert meta.finished is True and meta.extra["note"] == "ok"
+
+    store.create("bad", columns=_mkcols())
+    store.fail("bad", "boom")
+    doc = store.get("bad").metadata.to_doc()
+    assert doc["finished"] is True and doc["error"] == "boom"
+
+
+def test_value_counts(store):
+    cols = {"sex": np.array(["m", "f", "m", "m"], dtype=object)}
+    store.create("d", columns=cols, finished=True)
+    assert store.value_counts("d", "sex") == {"m": 3, "f": 1}
+
+
+def test_persistence_roundtrip(cfg):
+    cfg.persist = True
+    store = DatasetStore(cfg)
+    store.create("d", columns=_mkcols(6), url="file:///x.csv")
+    store.finish("d")
+    store2 = DatasetStore(cfg)
+    assert store2.load_all() == ["d"]
+    ds = store2.get("d")
+    assert ds.num_rows == 6
+    assert ds.metadata.finished is True
+    assert ds.metadata.url == "file:///x.csv"
+    assert list(ds.column("a")[:3]) == [0, 1, 2]
+    assert ds.column("name")[2] == "r2"
+
+
+def test_value_counts_nulls(store):
+    import numpy as np
+    cols = {"s": np.array(["m", None, "m", None], dtype=object),
+            "x": np.array([1.0, float("nan"), 2.0, 1.0])}
+    store.create("n", columns=cols, finished=True)
+    assert store.value_counts("n", "s") == {"m": 2, None: 2}
+    assert store.value_counts("n", "x") == {1.0: 2, 2.0: 1, None: 1}
+
+
+def test_read_pagination_skip_past_metadata(store):
+    import numpy as np
+    store.create("p", columns={"a": np.arange(5)}, finished=True)
+    docs = store.read("p", skip=1, limit=2)
+    assert [d["_id"] for d in docs] == [1, 2]
+    docs = store.read("p", skip=0, limit=1)
+    assert [d["_id"] for d in docs] == [0]
+
+
+def test_concurrent_append_and_read():
+    """Regression for the consolidation race: reader consolidating while the
+    ingest thread appends must never drop a chunk."""
+    import threading
+    import numpy as np
+    from learningorchestra_tpu.catalog.dataset import Dataset, Metadata
+
+    ds = Dataset(Metadata(name="r"))
+    n_chunks, rows = 200, 50
+
+    def writer():
+        for i in range(n_chunks):
+            ds.append_columns({"a": np.full(rows, i)})
+
+    def reader():
+        for _ in range(500):
+            _ = ds.columns
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ds.num_rows == n_chunks * rows

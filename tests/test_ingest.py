@@ -1,0 +1,94 @@
+"""Ingestion tests: streaming pipeline, type inference, URL sniffing, async
+job protocol (reference call stack §3.1)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.catalog.ingest import (
+    InvalidCsvUrl, _sniff_header, ingest_csv_text, ingest_csv_url)
+from learningorchestra_tpu.jobs import JobManager
+
+CSV = "age,fare,name\n22,7.25,braund\n38,71.28,cumings\n26,,allen\n"
+
+
+def test_ingest_text_types(store, cfg):
+    store.create("t", url="inline")
+    ingest_csv_text(store, "t", CSV, cfg)
+    ds = store.get("t")
+    assert ds.metadata.finished is True
+    assert ds.metadata.fields == ["age", "fare", "name"]
+    assert ds.column("age").dtype.kind == "i"
+    assert ds.column("fare").dtype.kind == "f"
+    assert np.isnan(ds.column("fare")[2])
+    assert ds.column("name")[0] == "braund"
+
+
+def test_ingest_local_file(store, cfg, tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text(CSV)
+    store.create("f", url=str(p))
+    ingest_csv_url(store, "f", str(p), cfg)
+    assert store.get("f").num_rows == 3
+
+
+def test_ingest_chunked_many_rows(store, cfg, tmp_path):
+    cfg.ingest_chunk_rows = 100
+    n = 1234
+    lines = ["x,y"] + [f"{i},{i * 2}" for i in range(n)]
+    p = tmp_path / "big.csv"
+    p.write_text("\n".join(lines) + "\n")
+    store.create("big", url=str(p))
+    ingest_csv_url(store, "big", str(p), cfg)
+    ds = store.get("big")
+    assert ds.num_rows == n
+    assert ds.column("y")[n - 1] == (n - 1) * 2
+
+
+def test_sniff_rejects_html_and_json():
+    with pytest.raises(InvalidCsvUrl):
+        _sniff_header(b"<!DOCTYPE html><html>", "u")
+    with pytest.raises(InvalidCsvUrl):
+        _sniff_header(b'{"a": 1}', "u")
+    _sniff_header(b"a,b,c\n1,2,3\n", "u")  # ok
+
+
+def test_async_job_failure_flips_finished_with_error(store, cfg):
+    store.create("j", url="nonexistent://x")
+    jm = JobManager(store)
+    jm.submit("ingest", "j",
+              lambda: ingest_csv_url(store, "j", "/does/not/exist.csv", cfg))
+    jm.wait_all(timeout=10)
+    doc = store.get("j").metadata.to_doc()
+    assert doc["finished"] is True
+    assert "error" in doc
+    recs = jm.records()
+    assert recs[0]["status"] == "failed"
+
+
+def test_async_job_success(store, cfg, tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text(CSV)
+    store.create("ok", url=str(p))
+    jm = JobManager(store)
+    jm.submit("ingest", "ok", lambda: ingest_csv_url(store, "ok", str(p), cfg))
+    jm.wait_all(timeout=10)
+    assert store.get("ok").metadata.finished is True
+    assert store.get("ok").num_rows == 3
+
+
+def test_ingest_backpressure_pipeline(store, cfg, tmp_path):
+    """Downloader thread + parser must terminate cleanly even when the parser
+    is slower (bounded queue backpressure, reference database.py:134-135)."""
+    n = 5000
+    p = tmp_path / "bp.csv"
+    p.write_text("a,b\n" + "\n".join(f"{i},{i}" for i in range(n)) + "\n")
+    cfg.ingest_chunk_rows = 50
+    store.create("bp", url=str(p))
+    t = threading.Thread(
+        target=ingest_csv_url, args=(store, "bp", str(p), cfg))
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert store.get("bp").num_rows == n
