@@ -138,18 +138,21 @@ class Dataset:
         self.append_columns(cols)
 
     def set_column(self, name: str, values: np.ndarray) -> None:
-        """Replace/add a full column (used by type coercion)."""
+        """Replace/add a full column (used by type coercion). Atomic:
+        snapshot, length-check, and replacement all happen under the data
+        lock so a concurrent append can never be silently dropped."""
         values = np.asarray(values)
-        if self.num_rows and len(values) != self.num_rows:
-            raise ValueError(
-                f"column length {len(values)} != num_rows {self.num_rows}")
-        cols = dict(self.columns)
-        cols[name] = values
-        if name not in self.metadata.fields:
-            self.metadata.fields.append(name)
         with self._data_lock:
+            cols = dict(self._consolidate_locked())
+            n = len(next(iter(cols.values()))) if cols else 0
+            if n and len(values) != n:
+                raise ValueError(
+                    f"column length {len(values)} != num_rows {n}")
+            cols[name] = values
+            if name not in self.metadata.fields:
+                self.metadata.fields.append(name)
             self._chunks = [{f: cols[f] for f in self.metadata.fields}]
-            self._consolidated = None
+            self._consolidated = self._chunks[0]
 
     # -- reads --------------------------------------------------------------
 
@@ -158,22 +161,30 @@ class Dataset:
         with self._data_lock:
             return sum(len(next(iter(c.values()))) for c in self._chunks)
 
+    def _consolidate_locked(self) -> Columns:
+        """Consolidate chunks; caller must hold ``_data_lock``."""
+        if self._consolidated is None:
+            if not self._chunks:
+                self._consolidated = {}
+            elif len(self._chunks) == 1:
+                self._consolidated = self._chunks[0]
+            else:
+                fields = self.metadata.fields
+                self._consolidated = {
+                    f: _concat([c[f] for c in self._chunks])
+                    for f in fields}
+                self._chunks = [self._consolidated]
+        return self._consolidated
+
     @property
     def columns(self) -> Columns:
-        """Consolidated column arrays (cached; invalidated by appends)."""
+        """Consolidated column arrays (cached; invalidated by appends).
+
+        The returned dict is an immutable snapshot: appends build a new
+        consolidation rather than mutating these arrays, so callers can
+        compute over it without holding the lock."""
         with self._data_lock:
-            if self._consolidated is None:
-                if not self._chunks:
-                    self._consolidated = {}
-                elif len(self._chunks) == 1:
-                    self._consolidated = self._chunks[0]
-                else:
-                    fields = self.metadata.fields
-                    self._consolidated = {
-                        f: _concat([c[f] for c in self._chunks])
-                        for f in fields}
-                    self._chunks = [self._consolidated]
-            return self._consolidated
+            return self._consolidate_locked()
 
     def column(self, name: str) -> np.ndarray:
         return self.columns[name]
@@ -181,14 +192,7 @@ class Dataset:
     def rows(self, indices: np.ndarray) -> List[Dict[str, Any]]:
         """Materialize row documents (``_id`` = index+1) for the given
         0-based row indices — the read-back path (reference database.py:36-48)."""
-        cols = self.columns
-        out = []
-        for i in indices:
-            doc = {"_id": int(i) + 1}
-            for f in self.metadata.fields:
-                doc[f] = _pyval(cols[f][i])
-            out.append(doc)
-        return out
+        return rows_from(self.columns, self.metadata.fields, indices)
 
     def numeric_matrix(self, fields: Optional[List[str]] = None) -> np.ndarray:
         """Dense float32 design matrix over the given (default: all numeric)
@@ -209,9 +213,44 @@ class Dataset:
 
 
 def _concat(arrays: List[np.ndarray]) -> np.ndarray:
-    if any(a.dtype == object for a in arrays):
+    """Concatenate column chunks, reconciling dtypes.
+
+    Chunked parsing infers dtypes per chunk, so a column can arrive numeric
+    in early chunks and object (string) later (e.g. 'N/A' first appears at
+    row 70k). A whole-file parse would have made every value a string, so on
+    conflict numeric values are stringified (ints exactly; NaN → None) to
+    keep one consistent value domain for queries and value_counts."""
+    has_obj = any(a.dtype == object for a in arrays)
+    if has_obj and any(a.dtype != object for a in arrays):
+        arrays = [_stringify(a) if a.dtype != object else a for a in arrays]
+    elif has_obj:
         arrays = [a.astype(object) for a in arrays]
     return np.concatenate(arrays)
+
+
+def _stringify(a: np.ndarray) -> np.ndarray:
+    out = np.empty(len(a), dtype=object)
+    is_float = a.dtype.kind == "f"
+    for i, v in enumerate(a):
+        if is_float and np.isnan(v):
+            out[i] = None
+        elif is_float and v == int(v):
+            out[i] = str(int(v))
+        else:
+            out[i] = str(v)
+    return out
+
+
+def rows_from(cols: Columns, fields: List[str],
+              indices: np.ndarray) -> List[Dict[str, Any]]:
+    """Materialize row docs from a column snapshot (lock-free)."""
+    out = []
+    for i in indices:
+        doc = {"_id": int(i) + 1}
+        for f in fields:
+            doc[f] = _pyval(cols[f][i])
+        out.append(doc)
+    return out
 
 
 def _pyval(v):

@@ -88,9 +88,8 @@ def parse_csv_bytes(data: bytes, has_header: bool = True) -> dict:
                 arr = np.ctypeslib.as_array(ptr, shape=(nrows,)).copy()
                 # Integral float columns → int64, matching pandas/reference
                 # inference (database.py:163-168 float→int when integral).
-                finite = arr[~np.isnan(arr)]
-                if finite.size and np.all(finite == np.floor(finite)) \
-                        and not np.isnan(arr).any():
+                if arr.size and not np.isnan(arr).any() \
+                        and np.all(arr == np.floor(arr)):
                     arr = arr.astype(np.int64)
                 out[name] = arr
             else:

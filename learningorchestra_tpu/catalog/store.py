@@ -22,13 +22,15 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from learningorchestra_tpu.catalog.dataset import Columns, Dataset, Metadata
+from learningorchestra_tpu.catalog.dataset import (
+    Columns, Dataset, Metadata, rows_from as _rows_from)
 from learningorchestra_tpu.config import Settings, settings as global_settings
 
 
@@ -38,6 +40,19 @@ class DatasetNotFound(KeyError):
 
 class DatasetExists(ValueError):
     pass
+
+
+#: Dataset names become directory names under store_root and arrive from the
+#: REST API, so they must never traverse paths.
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.\-]*$")
+
+
+def validate_name(name: str) -> str:
+    if not isinstance(name, str) or not _NAME_RE.match(name) or ".." in name:
+        raise ValueError(
+            f"invalid dataset name {name!r}: use letters, digits, '_', '-', "
+            "'.' (must start with a letter or digit)")
+    return name
 
 
 class DatasetStore:
@@ -54,6 +69,7 @@ class DatasetStore:
                parent: Optional[str] = None, finished: bool = False,
                columns: Optional[Columns] = None,
                extra: Optional[Dict[str, Any]] = None) -> Dataset:
+        validate_name(name)
         with self._lock:
             if name in self._datasets:
                 # Reference returns 409 on duplicate filename
@@ -124,28 +140,37 @@ class DatasetStore:
         (reference database.py:36-48, server.py:62-76)."""
         ds = self.get(name)
         query = query or {}
+        if limit <= 0:
+            return []
         docs: List[Dict[str, Any]] = []
         meta_doc = ds.metadata.to_doc()
         n_meta = 1 if _doc_matches(meta_doc, query) else 0
         if n_meta and skip == 0:
             docs.append(meta_doc)
-        idx = self._query_indices(ds, query)
+        # One consistent snapshot for the whole read: ds.columns is an
+        # immutable consolidation, so mask lengths and row materialization
+        # can't diverge even while an ingest job is appending.
+        cols = ds.columns
+        idx = self._query_indices(cols, ds.metadata.fields, query)
         # Apply skip/limit on indices BEFORE materializing row dicts (the
         # reference pushed skip/limit into the Mongo cursor,
         # database.py:107-111).
         row_skip = max(0, skip - n_meta)
-        idx = idx[row_skip:row_skip + limit - len(docs)]
-        docs.extend(ds.rows(idx))
+        remaining = limit - len(docs)
+        idx = idx[row_skip:row_skip + remaining] if remaining > 0 else idx[:0]
+        docs.extend(_rows_from(cols, ds.metadata.fields, idx))
         return docs
 
-    def _query_indices(self, ds: Dataset, query: Dict[str, Any]) -> np.ndarray:
-        n = ds.num_rows
+    @staticmethod
+    def _query_indices(cols, fields: List[str],
+                       query: Dict[str, Any]) -> np.ndarray:
+        n = len(next(iter(cols.values()))) if cols else 0
         mask = np.ones(n, dtype=bool)
         for field, cond in query.items():
             if field == "_id":
                 vals = np.arange(1, n + 1)
-            elif field in ds.columns:
-                vals = ds.columns[field]
+            elif field in cols:
+                vals = cols[field]
             else:
                 mask[:] = False
                 break
@@ -182,6 +207,8 @@ class DatasetStore:
     # -- persistence ---------------------------------------------------------
 
     def _path(self, name: str) -> str:
+        # Defense in depth alongside validate_name at create time.
+        validate_name(name)
         return os.path.join(self.cfg.store_root, name)
 
     def save(self, name: str) -> None:

@@ -1,0 +1,244 @@
+"""Python client SDK.
+
+Mirrors the reference pip package ``learning_orchestra_client`` (reference
+learning_orchestra_client/__init__.py): one class per service —
+``DatabaseApi``, ``Projection``, ``Histogram``, ``DataTypeHandler``,
+``Tsne``, ``Pca``, ``Model`` — sharing a ``Context`` and an
+``AsyncronousWait`` helper that polls a dataset's metadata until
+``finished`` flips true (reference __init__.py:14-32, 3-second cadence).
+
+Differences from the reference, by design:
+- one base URL instead of seven hard-coded ports (__init__.py:56-333) —
+  the server hosts every surface under path prefixes;
+- polling raises ``JobFailed`` when metadata carries ``error`` (the
+  reference would poll forever on a crashed job, SURVEY.md §5);
+- ``Model.create_model`` takes declarative ``steps`` in place of
+  arbitrary ``preprocessor_code`` (exec is opt-in server-side).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import requests
+
+DEFAULT_POLL_SECONDS = 3.0  # reference cadence (__init__.py:31)
+
+
+class JobFailed(RuntimeError):
+    pass
+
+
+class Context:
+    """Connection context shared by the service clients."""
+
+    def __init__(self, base_url: str, poll_seconds: float =
+                 DEFAULT_POLL_SECONDS, timeout: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self.poll_seconds = poll_seconds
+        self.timeout = timeout
+
+    def url(self, path: str) -> str:
+        return f"{self.base_url}{path}"
+
+
+class ResponseTreat:
+    """Uniform response handling (reference __init__.py:35-52)."""
+
+    @staticmethod
+    def treatment(response, pretty: bool = False):
+        payload = response.json()
+        if response.status_code >= 400:
+            raise RuntimeError(
+                f"HTTP {response.status_code}: {payload.get('result')}")
+        return json.dumps(payload, indent=2) if pretty else payload
+
+
+class AsyncronousWait:
+    """Polls dataset metadata until finished (reference __init__.py:14-32;
+    the misspelling is the reference's own public API name)."""
+
+    def __init__(self, context: Context):
+        self.context = context
+
+    def wait(self, dataset_name: str) -> Dict[str, Any]:
+        deadline = time.time() + self.context.timeout
+        while True:
+            resp = requests.get(
+                self.context.url(f"/files/{dataset_name}"),
+                params={"limit": 1})
+            if resp.status_code == 404:
+                raise KeyError(f"dataset not found: {dataset_name}")
+            docs = ResponseTreat.treatment(resp)
+            if docs:
+                meta = docs[0]
+                if meta.get("error"):
+                    raise JobFailed(
+                        f"{dataset_name}: {meta['error']}")
+                if meta.get("finished"):
+                    return meta
+            if time.time() > deadline:
+                raise TimeoutError(f"timed out waiting for {dataset_name}")
+            time.sleep(self.context.poll_seconds)
+
+
+class _ServiceClient:
+    def __init__(self, context: Context):
+        self.context = context
+        self.waiter = AsyncronousWait(context)
+
+
+class DatabaseApi(_ServiceClient):
+    """Dataset CRUD (reference __init__.py:55-101)."""
+
+    def create_file(self, filename: str, url: str,
+                    wait: bool = False) -> Dict:
+        resp = requests.post(self.context.url("/files"),
+                             json={"filename": filename, "url": url})
+        out = ResponseTreat.treatment(resp)
+        if wait:
+            self.waiter.wait(filename)
+        return out
+
+    def read_file(self, filename: str, skip: int = 0, limit: int = 10,
+                  query: Optional[Dict] = None) -> List[Dict]:
+        params = {"skip": skip, "limit": limit}
+        if query:
+            params["query"] = json.dumps(query)
+        return ResponseTreat.treatment(requests.get(
+            self.context.url(f"/files/{filename}"), params=params))
+
+    def read_files_descriptor(self) -> List[Dict]:
+        return ResponseTreat.treatment(
+            requests.get(self.context.url("/files")))
+
+    def delete_file(self, filename: str) -> Dict:
+        return ResponseTreat.treatment(
+            requests.delete(self.context.url(f"/files/{filename}")))
+
+
+class Projection(_ServiceClient):
+    """Column projection (reference __init__.py:104-135)."""
+
+    def create_projection(self, parent_filename: str,
+                          projection_filename: str,
+                          fields: Sequence[str],
+                          wait: bool = True) -> Dict:
+        self.waiter.wait(parent_filename)
+        resp = requests.post(
+            self.context.url(f"/projections/{parent_filename}"),
+            json={"projection_filename": projection_filename,
+                  "fields": list(fields)})
+        out = ResponseTreat.treatment(resp)
+        if wait:
+            self.waiter.wait(projection_filename)
+        return out
+
+
+class Histogram(_ServiceClient):
+    """Histogram creation (reference __init__.py:138-169)."""
+
+    def create_histogram(self, parent_filename: str,
+                         histogram_filename: str, fields: Sequence[str],
+                         wait: bool = True) -> Dict:
+        self.waiter.wait(parent_filename)
+        resp = requests.post(
+            self.context.url(f"/histograms/{parent_filename}"),
+            json={"histogram_filename": histogram_filename,
+                  "fields": list(fields)})
+        out = ResponseTreat.treatment(resp)
+        if wait:
+            self.waiter.wait(histogram_filename)
+        return out
+
+
+class DataTypeHandler(_ServiceClient):
+    """Field type coercion (reference __init__.py:311-329)."""
+
+    def change_file_type(self, filename: str,
+                         fields_dict: Dict[str, str]) -> Dict:
+        self.waiter.wait(filename)
+        return ResponseTreat.treatment(requests.patch(
+            self.context.url(f"/fieldtypes/{filename}"), json=fields_dict))
+
+
+class _ImageClient(_ServiceClient):
+    method = ""
+
+    def create_image_plot(self, image_name: str, parent_filename: str,
+                          label_name: Optional[str] = None,
+                          wait: bool = True, **kwargs) -> Dict:
+        self.waiter.wait(parent_filename)
+        body = {"image_name": image_name, **kwargs}
+        if label_name:
+            body["label_name"] = label_name
+        resp = requests.post(
+            self.context.url(f"/{self.method}/images/{parent_filename}"),
+            json=body)
+        out = ResponseTreat.treatment(resp)
+        if wait and "poll" in out:
+            self.waiter.wait(out["poll"])
+        return out
+
+    def read_image_plot(self, image_name: str) -> bytes:
+        resp = requests.get(
+            self.context.url(f"/{self.method}/images/{image_name}"))
+        if resp.status_code >= 400:
+            raise RuntimeError(f"HTTP {resp.status_code}")
+        return resp.content
+
+    def read_image_plots(self) -> List[str]:
+        return ResponseTreat.treatment(requests.get(
+            self.context.url(f"/{self.method}/images")))
+
+    def delete_image_plot(self, image_name: str) -> Dict:
+        return ResponseTreat.treatment(requests.delete(
+            self.context.url(f"/{self.method}/images/{image_name}")))
+
+
+class Tsne(_ImageClient):
+    """t-SNE image service (reference __init__.py:172-240)."""
+
+    method = "tsne"
+
+
+class Pca(_ImageClient):
+    """PCA image service (reference __init__.py:243-308)."""
+
+    method = "pca"
+
+
+class Model(_ServiceClient):
+    """Model builder (reference __init__.py:332-370)."""
+
+    def create_model(self, training_filename: str, test_filename: str,
+                     prediction_filename: str,
+                     classificators_list: Sequence[str], label: str,
+                     steps: Sequence[Dict[str, Any]] = (),
+                     preprocessor_code: Optional[str] = None,
+                     hparams: Optional[Dict] = None,
+                     sync: bool = True) -> Dict:
+        # Wait on both input datasets first (reference __init__.py:358-359).
+        self.waiter.wait(training_filename)
+        self.waiter.wait(test_filename)
+        body: Dict[str, Any] = {
+            "training_filename": training_filename,
+            "test_filename": test_filename,
+            "prediction_filename": prediction_filename,
+            "classificators_list": list(classificators_list),
+            "label": label, "sync": sync,
+        }
+        if steps:
+            body["steps"] = list(steps)
+        if preprocessor_code is not None:
+            body["preprocessor_code"] = preprocessor_code
+        if hparams:
+            body["hparams"] = hparams
+        out = ResponseTreat.treatment(requests.post(
+            self.context.url("/models"), json=body))
+        if not sync:
+            for c in classificators_list:
+                self.waiter.wait(f"{prediction_filename}_{c}")
+        return out

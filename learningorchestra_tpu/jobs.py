@@ -45,6 +45,10 @@ class JobRecord:
 class JobManager:
     """Bounded-pool async job runner with per-dataset failure recording."""
 
+    #: Terminal job records kept for /jobs observability; oldest evicted
+    #: beyond this so a long-lived server doesn't leak a record per job.
+    MAX_RECORDS = 1000
+
     def __init__(self, store, max_workers: int = 8):
         self.store = store
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
@@ -62,6 +66,12 @@ class JobManager:
             rec = JobRecord(job_id=f"{kind}-{self._seq}", dataset=dataset,
                             kind=kind)
             self._jobs[rec.job_id] = rec
+            if len(self._jobs) > self.MAX_RECORDS:
+                for jid, r in list(self._jobs.items()):
+                    if len(self._jobs) <= self.MAX_RECORDS:
+                        break
+                    if r.status != "running":
+                        del self._jobs[jid]
 
         def run():
             try:

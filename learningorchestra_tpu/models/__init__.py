@@ -1,0 +1,3 @@
+from learningorchestra_tpu.models.registry import (  # noqa: F401
+    CLASSIFIERS, get_trainer)
+from learningorchestra_tpu.models.builder import ModelBuilder  # noqa: F401

@@ -1,0 +1,58 @@
+"""Trainer interface shared by all classifier families.
+
+The reference's model zoo is the pyspark.ml switcher
+``{lr, dt, rf, gb, nb}`` (reference model_builder.py:152-158): each entry
+fits on a Spark DataFrame of assembled feature vectors and transforms the
+test set into prediction + probability columns. Here a trainer is a function
+``fit(runtime, X, y, num_classes, seed, **hparams) -> TrainedModel`` over
+dense device arrays; every fit shards rows across the mesh data axis and
+returns replicated parameters, so predict runs on any subset of devices.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+
+@dataclass
+class TrainedModel:
+    """A fitted classifier: replicated params + a jit'd probability fn."""
+
+    kind: str
+    params: Any                       # pytree of replicated jax arrays
+    predict_proba_fn: Callable        # (params, X_dev) -> (n, C) probs
+    num_classes: int
+    hparams: Dict[str, Any] = field(default_factory=dict)
+
+    def predict_proba(self, runtime: MeshRuntime, X: np.ndarray) -> np.ndarray:
+        X_dev, n = runtime.shard_rows(np.asarray(X, np.float32))
+        probs = self.predict_proba_fn(self.params, X_dev)
+        return np.asarray(probs)[:n]
+
+    def predict(self, runtime: MeshRuntime, X: np.ndarray) -> np.ndarray:
+        return np.argmax(self.predict_proba(runtime, X), axis=1)
+
+
+@dataclass
+class FitReport:
+    """What the reference persists per classifier: the model's metrics +
+    wall-clock fit time (model_builder.py:199-225)."""
+
+    kind: str
+    fit_time: float
+    metrics: Dict[str, float] = field(default_factory=dict)
+
+
+class Timer:
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.time() - self.t0

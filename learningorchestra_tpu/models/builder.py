@@ -1,0 +1,149 @@
+"""ModelBuilder — the trainer service core (reference call stack §3.2).
+
+The reference's ``SparkModelBuilder.build_model``: load train/test
+collections, ``exec()`` user preprocessing, fit up to 5 classifiers
+*concurrently* (ThreadPoolExecutor submitting into one FAIR-scheduled
+SparkSession, model_builder.py:95,160-176), time each fit, evaluate F1 +
+accuracy, and write one prediction collection per classifier whose metadata
+carries the metrics and whose rows are the test set plus ``prediction`` and
+``probability`` columns (with vector internals dropped,
+model_builder.py:179-248).
+
+TPU-native design: preprocessing is declarative (ops/preprocess; exec only
+behind the opt-in flag); each classifier family is one jit-compiled program
+(models/*), so "concurrent fits" become overlapped dispatch of XLA
+executables — the Python thread pool only overlaps compile/host time while
+the device queue serializes the actual steps back-to-back with zero
+inter-job gap (the FAIR-scheduler role). Output contract is preserved:
+dataset ``<name>_<classifier>`` per classifier, metrics in its metadata.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.config import Settings, settings as global_settings
+from learningorchestra_tpu.models.base import FitReport, Timer
+from learningorchestra_tpu.models.metrics import classification_metrics
+from learningorchestra_tpu.models.registry import get_trainer
+from learningorchestra_tpu.ops import preprocess
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+
+class ModelBuilder:
+    def __init__(self, store: DatasetStore, runtime: MeshRuntime,
+                 cfg: Optional[Settings] = None):
+        self.store = store
+        self.runtime = runtime
+        self.cfg = cfg or global_settings
+
+    # -- validation (reference model_builder.py:272-292) ---------------------
+
+    def validate(self, train: str, test: str, classifiers: Sequence[str],
+                 prediction_name: str) -> None:
+        for ds_name in (train, test):
+            if not self.store.exists(ds_name):
+                raise KeyError(f"dataset not found: {ds_name}")
+        for c in classifiers:
+            get_trainer(c)  # raises ValueError on unknown name
+        for c in classifiers:
+            if self.store.exists(f"{prediction_name}_{c}"):
+                raise ValueError(
+                    f"prediction dataset already exists: {prediction_name}_{c}")
+
+    # -- the main path -------------------------------------------------------
+
+    def build(self, train: str, test: str, prediction_name: str,
+              classifiers: Sequence[str], label: str,
+              steps: Sequence[Dict[str, Any]] = (),
+              preprocessor_code: Optional[str] = None,
+              hparams: Optional[Dict[str, Dict[str, Any]]] = None,
+              ) -> List[FitReport]:
+        """Fit all requested classifiers; returns per-classifier reports.
+
+        Synchronous core (the reference's POST /models also blocks until all
+        fits finish, SURVEY.md §3.2); the serving layer may wrap it in a job.
+        """
+        train_ds = self.store.get(train)
+        test_ds = self.store.get(test)
+        hparams = hparams or {}
+
+        if preprocessor_code is not None:
+            if not self.cfg.allow_exec_preprocessing:
+                raise PermissionError(
+                    "exec preprocessing is disabled; enable "
+                    "LO_TPU_ALLOW_EXEC or use declarative steps")
+            X_train, y_train, X_test, y_test = preprocess.exec_preprocess(
+                preprocessor_code, train_ds, test_ds, label)
+            feature_fields = [f"f{i}" for i in range(X_train.shape[1])]
+        else:
+            X_train, y_train, feature_fields, state = preprocess.design_matrix(
+                train_ds, label, steps)
+            X_test, y_test, _, _ = preprocess.design_matrix(
+                test_ds, label, steps, state=state,
+                feature_fields=feature_fields)
+        if y_train is None:
+            raise ValueError(f"label field {label!r} not in {train!r}")
+        num_classes = int(max(int(y_train.max()) + 1,
+                              2 if y_test is None else int(y_test.max()) + 1))
+
+        # Create all output datasets first (metadata-first protocol), so
+        # pollers see them immediately with finished=false.
+        for c in classifiers:
+            self.store.create(f"{prediction_name}_{c}", parent=test,
+                              extra={"classifier": c, "label": label})
+
+        def fit_one(c: str) -> FitReport:
+            trainer = get_trainer(c)
+            with Timer() as t:
+                model = trainer(self.runtime, X_train, y_train, num_classes,
+                                **hparams.get(c, {}))
+                probs = model.predict_proba(self.runtime, X_test)
+            preds = np.argmax(probs, axis=1)
+            report = FitReport(kind=c, fit_time=t.elapsed)
+            if y_test is not None and (y_test >= 0).all():
+                report.metrics = classification_metrics(
+                    y_test, preds, num_classes)
+            self._save_predictions(f"{prediction_name}_{c}", test_ds,
+                                   preds, probs, report)
+            return report
+
+        # Concurrent fits (reference: 5-way ThreadPoolExecutor + FAIR pool).
+        with ThreadPoolExecutor(
+                max_workers=self.cfg.max_concurrent_fits) as pool:
+            futures = {c: pool.submit(fit_one, c) for c in classifiers}
+            reports = []
+            for c, fut in futures.items():
+                try:
+                    reports.append(fut.result())
+                except Exception as exc:  # noqa: BLE001 — per-model boundary
+                    self.store.fail(f"{prediction_name}_{c}",
+                                    f"{type(exc).__name__}: {exc}")
+                    reports.append(FitReport(kind=c, fit_time=0.0,
+                                             metrics={"error": str(exc)}))
+        return reports
+
+    def _save_predictions(self, name: str, test_ds, preds: np.ndarray,
+                          probs: np.ndarray, report: FitReport) -> None:
+        """Write the prediction dataset: original test rows + prediction +
+        probability list; metrics into metadata (reference
+        model_builder.py:191-248 drops 'features'/'rawPrediction' and
+        converts the probability vector to a plain list)."""
+        ds = self.store.get(name)
+        cols = {f: test_ds.columns[f] for f in test_ds.metadata.fields}
+        cols["prediction"] = preds.astype(np.int64)
+        # Object array of Python lists (np.array(list-of-lists, dtype=object)
+        # would build a 2-D array instead).
+        prob_col = np.empty(len(probs), dtype=object)
+        for i, p in enumerate(probs):
+            prob_col[i] = [float(x) for x in p]
+        cols["probability"] = prob_col
+        ds.append_columns(cols)
+        self.store.finish(
+            name,
+            fit_time=report.fit_time,
+            **{k: v for k, v in report.metrics.items()})

@@ -1,0 +1,92 @@
+"""Logistic regression trainer ("lr" in the classifier registry).
+
+The reference's "lr" is ``pyspark.ml.classification.LogisticRegression``
+fitted as a distributed iterative Spark job (reference model_builder.py:152,
+200). TPU-native design: multinomial logistic regression as one jit-compiled
+program — a ``lax.scan`` over full-batch Adam steps on the standardized
+design matrix. Rows are sharded across the mesh data axis; the loss is a
+masked mean, so its gradient contracts over the sharded row dimension and
+XLA inserts the ICI all-reduce automatically (no hand-written collectives).
+bfloat16 matmuls feed the MXU; parameters stay float32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+
+def _logits(params, X):
+    W, b, mu, sigma = (params["W"], params["b"], params["mu"],
+                       params["sigma"])
+    Xs = ((X - mu) / sigma).astype(jnp.bfloat16)
+    return (Xs @ W.astype(jnp.bfloat16)).astype(jnp.float32) + b
+
+
+def _loss(params, X, y, mask, l2):
+    logits = _logits(params, X)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+    data = jnp.sum(nll * mask) / jnp.sum(mask)
+    return data + l2 * jnp.sum(params["W"] ** 2)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "iters"))
+def _fit(X, y, n_valid, mu, sigma, *, num_classes, iters, lr, l2, seed):
+    n, d = X.shape
+    k = jax.random.PRNGKey(seed)
+    params = {
+        "W": 0.01 * jax.random.normal(k, (d, num_classes), jnp.float32),
+        "b": jnp.zeros((num_classes,), jnp.float32),
+        "mu": mu, "sigma": sigma,
+    }
+    mask = (jnp.arange(n) < n_valid).astype(jnp.float32)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    def step(carry, _):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(_loss)(params, X, y, mask, l2)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    (params, _), losses = jax.lax.scan(step, (params, opt_state), None,
+                                       length=iters)
+    return params, losses
+
+
+@jax.jit
+def _predict_proba(params, X):
+    return jax.nn.softmax(_logits(params, X), axis=-1)
+
+
+def _standardization_stats(X: np.ndarray):
+    mu = X.mean(axis=0)
+    sigma = X.std(axis=0)
+    sigma = np.where(sigma < 1e-7, 1.0, sigma)
+    return mu.astype(np.float32), sigma.astype(np.float32)
+
+
+def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
+        num_classes: int, seed: int = 0, *, iters: int = 300,
+        lr: float = 0.1, l2: float = 1e-4) -> TrainedModel:
+    X = np.asarray(X, np.float32)
+    mu, sigma = _standardization_stats(X)
+    X_dev, n = runtime.shard_rows(X)
+    y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
+    params, _ = _fit(X_dev, y_dev, runtime.replicate(np.int32(n)),
+                     runtime.replicate(mu), runtime.replicate(sigma),
+                     num_classes=num_classes, iters=iters, lr=lr, l2=l2,
+                     seed=seed)
+    return TrainedModel(kind="lr", params=params,
+                        predict_proba_fn=_predict_proba,
+                        num_classes=num_classes,
+                        hparams={"iters": iters, "lr": lr, "l2": l2})

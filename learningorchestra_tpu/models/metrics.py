@@ -1,0 +1,45 @@
+"""Evaluation metrics, device-side.
+
+The reference evaluates each fitted model with two Spark
+``MulticlassClassificationEvaluator`` jobs — metricName "f1" (weighted by
+class support) and "accuracy" (reference model_builder.py:206-225). Both are
+reproduced here from a single confusion matrix built with one scatter-add
+pass on device, so evaluation costs one kernel instead of two cluster jobs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def confusion_matrix(y_true: jax.Array, y_pred: jax.Array,
+                     num_classes: int) -> jax.Array:
+    idx = y_true * num_classes + y_pred
+    flat = jnp.zeros(num_classes * num_classes, jnp.float32).at[idx].add(1.0)
+    return flat.reshape(num_classes, num_classes)
+
+
+def classification_metrics(y_true: np.ndarray, y_pred: np.ndarray,
+                           num_classes: int) -> Dict[str, float]:
+    """accuracy + support-weighted F1 (pyspark's default "f1")."""
+    cm = np.asarray(confusion_matrix(
+        jnp.asarray(y_true, jnp.int32), jnp.asarray(y_pred, jnp.int32),
+        num_classes))
+    support = cm.sum(axis=1)
+    tp = np.diag(cm)
+    pred_pos = cm.sum(axis=0)
+    precision = np.where(pred_pos > 0, tp / np.maximum(pred_pos, 1), 0.0)
+    recall = np.where(support > 0, tp / np.maximum(support, 1), 0.0)
+    denom = precision + recall
+    f1 = np.where(denom > 0, 2 * precision * recall / np.maximum(denom, 1e-12),
+                  0.0)
+    total = support.sum()
+    weighted_f1 = float((f1 * support).sum() / max(total, 1))
+    accuracy = float(tp.sum() / max(total, 1))
+    return {"f1": weighted_f1, "accuracy": accuracy}

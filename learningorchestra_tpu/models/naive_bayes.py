@@ -1,0 +1,64 @@
+"""Naive Bayes trainer ("nb" in the classifier registry).
+
+The reference's "nb" is ``pyspark.ml.classification.NaiveBayes`` — a
+single-pass sufficient-statistics fit distributed over executors (reference
+model_builder.py:156). TPU-native design: Gaussian naive Bayes as one jitted
+pass — per-class masked sums of x and x² over the row-sharded design matrix
+(XLA reduces the sharded row axis with an ICI all-reduce), giving class
+priors, means, and variances in a single device program. Gaussian rather
+than the reference's multinomial event model because stored datasets carry
+signed continuous features, which multinomial NB cannot ingest without a
+lossy shift; metrics on the reference's own Titanic workload are comparable
+(see tests/test_models.py parity suite).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+_VAR_FLOOR = 1e-6
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _fit(X, y, n_valid, *, num_classes, smoothing):
+    n, d = X.shape
+    mask = (jnp.arange(n) < n_valid).astype(jnp.float32)
+    onehot = jax.nn.one_hot(y, num_classes, dtype=jnp.float32) * mask[:, None]
+    counts = onehot.sum(axis=0)                      # (C,)
+    sums = onehot.T @ X                              # (C, d) — MXU contraction
+    sqsums = onehot.T @ (X * X)                      # (C, d)
+    denom = jnp.maximum(counts, 1.0)[:, None]
+    mean = sums / denom
+    var = jnp.maximum(sqsums / denom - mean ** 2, _VAR_FLOOR) + smoothing
+    prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
+    return {"mean": mean, "var": var, "log_prior": prior}
+
+
+@jax.jit
+def _predict_proba(params, X):
+    mean, var, log_prior = params["mean"], params["var"], params["log_prior"]
+    # log N(x; mu, var) summed over features, per class: (n, C)
+    x2 = ((X[:, None, :] - mean[None]) ** 2) / var[None]
+    loglik = -0.5 * (x2 + jnp.log(2.0 * jnp.pi * var)[None]).sum(axis=-1)
+    return jax.nn.softmax(loglik + log_prior[None], axis=-1)
+
+
+def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
+        num_classes: int, seed: int = 0, *,
+        smoothing: float = 1e-3) -> TrainedModel:
+    X_dev, n = runtime.shard_rows(np.asarray(X, np.float32))
+    y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
+    params = _fit(X_dev, y_dev, runtime.replicate(np.int32(n)),
+                  num_classes=num_classes,
+                  smoothing=runtime.replicate(np.float32(smoothing)))
+    return TrainedModel(kind="nb", params=params,
+                        predict_proba_fn=_predict_proba,
+                        num_classes=num_classes,
+                        hparams={"smoothing": smoothing})

@@ -1,0 +1,31 @@
+"""Classifier registry — the reference's switcher, extended.
+
+The reference maps ``{"lr", "dt", "rf", "gb", "nb"}`` to pyspark.ml
+classifiers (reference model_builder.py:152-158) and returns 409 for unknown
+names (ModelBuilderRequestValidator, model_builder.py:284-292). Same five
+names here, plus the TPU-native "mlp" extension.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from learningorchestra_tpu.models import logistic, mlp, naive_bayes, trees
+
+CLASSIFIERS: Dict[str, Callable] = {
+    "lr": logistic.fit,
+    "dt": trees.fit_dt,
+    "rf": trees.fit_rf,
+    "gb": trees.fit_gb,
+    "nb": naive_bayes.fit,
+    "mlp": mlp.fit,
+}
+
+
+def get_trainer(name: str) -> Callable:
+    try:
+        return CLASSIFIERS[name]
+    except KeyError:
+        raise ValueError(
+            f"invalid classifier {name!r}; choose from "
+            f"{sorted(CLASSIFIERS)}") from None

@@ -1,0 +1,3 @@
+from learningorchestra_tpu.ops.projection import create_projection  # noqa: F401
+from learningorchestra_tpu.ops.histogram import create_histogram  # noqa: F401
+from learningorchestra_tpu.ops.dtypes import convert_fields  # noqa: F401

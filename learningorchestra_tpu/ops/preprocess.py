@@ -1,0 +1,176 @@
+"""Preprocessing: declarative steps + the design-matrix builder.
+
+The reference hands arbitrary user Python to ``exec()`` on the service
+driver, expecting it to produce assembled Spark feature DataFrames
+(reference model_builder.py:134-177) — full pyspark power, but arbitrary
+code execution in the server (SURVEY.md §7 flags it as the design flaw to
+supersede). Here the default path is a declarative, JSON-serializable step
+list covering what the docs' Titanic walkthrough actually does
+(drop columns, fill missing, encode strings, cast — docs/model_builder.md):
+
+    steps = [{"op": "drop", "fields": ["Name"]},
+             {"op": "fillna", "strategy": "mean"},
+             {"op": "label_encode", "fields": ["Sex"]},
+             {"op": "standardize"}]
+
+``exec`` preprocessing survives behind ``settings.allow_exec_preprocessing``
+(off by default): the code receives pandas DataFrames ``training_df`` /
+``testing_df`` and must set ``features_training``, ``labels_training``,
+``features_testing`` (numpy arrays) — the same names the reference's
+contract expects its Spark DataFrames under (model_builder.py:145-150).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from learningorchestra_tpu.catalog.dataset import Dataset
+
+
+class PreprocessError(ValueError):
+    pass
+
+
+def _label_encode(col: np.ndarray, vocab: Optional[Dict] = None):
+    """String column → int codes (sklearn LabelEncoder semantics, which the
+    reference's tsne/pca services apply to every string column,
+    tsne.py:82-86). None encodes as its own category."""
+    keyed = np.array(["\0none" if v is None else str(v) for v in col])
+    if vocab is None:
+        uniq = np.unique(keyed)
+        vocab = {v: i for i, v in enumerate(uniq)}
+    codes = np.array([vocab.get(v, len(vocab)) for v in keyed],
+                     dtype=np.int64)
+    return codes, vocab
+
+
+def apply_steps(columns: Dict[str, np.ndarray],
+                steps: Sequence[Dict[str, Any]],
+                state: Optional[Dict] = None) -> Tuple[Dict[str, np.ndarray],
+                                                       Dict]:
+    """Apply a step list. ``state`` carries fitted statistics (vocab, means)
+    so the same pipeline applies identically to train and test datasets."""
+    cols = dict(columns)
+    state = dict(state or {})
+    for i, step in enumerate(steps):
+        op = step.get("op")
+        key = f"{i}:{op}"
+        fields = step.get("fields") or [
+            f for f in cols
+            if (cols[f].dtype == object) == (op in ("label_encode",))]
+        if op == "select":
+            cols = {f: cols[f] for f in step["fields"]}
+        elif op == "drop":
+            cols = {f: c for f, c in cols.items()
+                    if f not in set(step["fields"])}
+        elif op == "label_encode":
+            vocabs = state.get(key, {})
+            for f in fields:
+                if cols[f].dtype != object:
+                    continue
+                codes, vocab = _label_encode(cols[f], vocabs.get(f))
+                vocabs[f] = vocab
+                cols[f] = codes
+            state[key] = vocabs
+        elif op == "fillna":
+            strategy = step.get("strategy", "mean")
+            fill = state.get(key, {})
+            for f, c in cols.items():
+                if c.dtype.kind != "f":
+                    continue
+                if f not in fill:
+                    if not np.isnan(c).any():
+                        continue
+                    if strategy == "mean":
+                        fill[f] = float(np.nanmean(c))
+                    elif strategy == "zero":
+                        fill[f] = 0.0
+                    elif strategy == "value":
+                        fill[f] = step["value"]
+                    else:
+                        raise PreprocessError(
+                            f"unknown fillna strategy {strategy!r}")
+                cols[f] = np.where(np.isnan(c), fill[f], c)
+            state[key] = fill
+        elif op == "cast":
+            dtype = step.get("dtype", "float32")
+            for f in step["fields"]:
+                cols[f] = cols[f].astype(dtype)
+        elif op == "standardize":
+            stats = state.get(key)
+            tgt = [f for f in cols if cols[f].dtype.kind in "if"]
+            if stats is None:
+                stats = {f: (float(np.nanmean(cols[f])),
+                             float(np.nanstd(cols[f]) or 1.0)) for f in tgt}
+            for f in tgt:
+                if f in stats:
+                    mu, sd = stats[f]
+                    cols[f] = (cols[f].astype(np.float64) - mu) / (sd or 1.0)
+            state[key] = stats
+        else:
+            raise PreprocessError(f"unknown preprocessing op: {op!r}")
+    return cols, state
+
+
+def design_matrix(ds: Dataset, label: str,
+                  steps: Sequence[Dict[str, Any]] = (),
+                  state: Optional[Dict] = None,
+                  feature_fields: Optional[List[str]] = None):
+    """Dataset → (X float32, y int32 or None, feature names, fitted state).
+
+    Default pipeline when ``steps`` is empty: label-encode every string
+    column, mean-fill NaNs — enough to train on raw ingested CSVs the way
+    the docs' Titanic example preprocesses by hand.
+    """
+    cols = dict(ds.columns)
+    y = None
+    label_state_key = "__label_vocab__"
+    state = dict(state or {})
+    if label in cols:
+        lab = cols.pop(label)
+        if lab.dtype == object:
+            codes, vocab = _label_encode(lab, state.get(label_state_key))
+            state[label_state_key] = vocab
+            y = codes.astype(np.int32)
+        else:
+            y = np.asarray(lab)
+            y = np.where(np.isnan(y.astype(np.float64)), -1, y).astype(
+                np.int32) if y.dtype.kind == "f" else y.astype(np.int32)
+    if not steps:
+        steps = [{"op": "label_encode"}, {"op": "fillna", "strategy": "mean"}]
+    cols, state = apply_steps(cols, steps, state)
+    if feature_fields is None:
+        feature_fields = [f for f in cols if cols[f].dtype.kind in "ifub"]
+    X = np.stack([np.asarray(cols[f], np.float32) for f in feature_fields],
+                 axis=1) if feature_fields else np.zeros((ds.num_rows, 0),
+                                                         np.float32)
+    return X, y, feature_fields, state
+
+
+def exec_preprocess(code: str, train_ds: Dataset, test_ds: Dataset,
+                    label: str):
+    """Sandboxed-by-flag exec path (reference model_builder.py:145-150)."""
+    import pandas as pd
+
+    scope: Dict[str, Any] = {
+        "training_df": pd.DataFrame(
+            {f: train_ds.columns[f] for f in train_ds.metadata.fields}),
+        "testing_df": pd.DataFrame(
+            {f: test_ds.columns[f] for f in test_ds.metadata.fields}),
+        "np": np, "pd": pd, "label": label,
+    }
+    exec(code, scope)  # noqa: S102 — gated by settings.allow_exec_preprocessing
+    try:
+        X_train = np.asarray(scope["features_training"], np.float32)
+        y_train = np.asarray(scope["labels_training"], np.int32)
+        X_test = np.asarray(scope["features_testing"], np.float32)
+    except KeyError as exc:
+        raise PreprocessError(
+            f"preprocessor code must define {exc} (plus features_training, "
+            "labels_training, features_testing)") from exc
+    y_test = scope.get("labels_testing")
+    if y_test is not None:
+        y_test = np.asarray(y_test, np.int32)
+    return X_train, y_train, X_test, y_test

@@ -1,0 +1,31 @@
+"""Projection service op: column subset of a dataset into a new dataset.
+
+The reference runs a Spark job — load collection, filter out the metadata
+row, ``select(*fields)``, append-write to the output collection, then rewrite
+metadata with ``finished=True`` (reference projection.py:104-125) — because
+its rows live as BSON documents that must be physically rewritten.
+
+Here columns are already independent arrays, so projection is a zero-copy
+column gather: the output dataset references the parent's arrays directly
+(copy-on-write applies — type coercion replaces whole columns, never mutates
+in place). The metadata-first / finished-flip protocol and field validation
+(fields ⊆ parent.fields, projection.py:141-167) are preserved exactly.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from learningorchestra_tpu.catalog.store import DatasetStore
+
+
+def create_projection(store: DatasetStore, parent: str, name: str,
+                      fields: List[str], existing: bool = False) -> None:
+    parent_ds = store.get(parent)
+    missing = [f for f in fields if f not in parent_ds.metadata.fields]
+    if missing:
+        raise ValueError(f"fields not in dataset: {missing}")
+    ds = store.get(name) if existing else store.create(name, parent=parent)
+    cols = parent_ds.columns
+    ds.append_columns({f: cols[f] for f in fields})
+    store.finish(name)

@@ -1,0 +1,70 @@
+"""Multi-host bootstrap — the communication backend over ICI/DCN.
+
+The reference's distributed backend is Spark standalone RPC: driver-in-
+service ↔ master:7077 ↔ workers:41352 over Docker overlay networks, with
+py4j bridging Python↔JVM and all bulk data routed through MongoDB
+(SURVEY.md §2 "Distributed communication backend"). Here the backend is
+``jax.distributed`` + XLA collectives: one controller process per TPU host
+joins a coordination service, after which ``jax.devices()`` is the *global*
+device list and every collective (psum/all_gather/reduce_scatter/ppermute
+emitted by pjit/shard_map) rides ICI within a slice and DCN across slices —
+no first-party RPC layer to maintain.
+
+Single-host (and CPU-simulated) runs skip initialization entirely; the same
+mesh code paths work unchanged, which is what lets tests run on an 8-device
+CPU mesh (tests/conftest.py) and the driver dry-run multi-chip shardings
+without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join (or start) the multi-host coordination service.
+
+    Arguments default from the standard env vars so a TPU pod launcher can
+    start identical processes on every host:
+
+    - ``LO_TPU_COORDINATOR`` (host:port of process 0),
+    - ``LO_TPU_NUM_PROCESSES``, ``LO_TPU_PROCESS_ID``.
+
+    On TPU VMs with cloud metadata available, ``jax.distributed.initialize``
+    auto-discovers all three. No-op when unset (single-host dev/test).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "LO_TPU_COORDINATOR")
+    if num_processes is None and "LO_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["LO_TPU_NUM_PROCESSES"])
+    if process_id is None and "LO_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["LO_TPU_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+
+
+def process_info() -> dict:
+    """Topology snapshot for the /cluster observability route."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+        "devices": [str(d) for d in jax.devices()],
+        "platform": jax.default_backend(),
+    }

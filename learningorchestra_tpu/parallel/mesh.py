@@ -1,0 +1,130 @@
+"""Mesh runtime — the compute tier replacing the reference's Spark cluster.
+
+The reference scales by adding Spark workers to a standalone cluster
+(`docker service scale microservice_sparkworker=N`, reference
+docs/usage.md:21-33) and partitions DataFrames across them (800 shuffle
+partitions, model_builder.py:80). The TPU-native equivalent is a
+``jax.sharding.Mesh`` over the attached devices with named axes:
+
+- ``data`` — rows of a dataset are sharded across this axis (the analogue of
+  Spark's RDD partitioning; SURVEY.md §2 parallelism #1). All trainers and
+  analytics reductions psum over it, which XLA lowers to ICI all-reduces.
+- ``model`` — parameters/features shard across this axis for wide models
+  (no Spark analogue; the TPU-idiomatic hook SURVEY.md §2 calls for).
+
+Arrays move host→device exactly once per job via ``shard_rows`` (row-sharded
+``jax.device_put``); every subsequent op runs device-side. Multi-host:
+``jax.distributed`` bootstrap lives in ``parallel/distributed.py``; this
+module only sees the global device list, so the same code drives 1 chip or a
+pod slice.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from learningorchestra_tpu.config import Settings, settings as global_settings
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def local_mesh(cfg: Optional[Settings] = None,
+               devices=None) -> Mesh:
+    """Build the (data, model) mesh over the given (default: all) devices.
+
+    Default layout puts every device on the data axis — the reference's
+    pure-data-parallel Spark layout. ``cfg.mesh_shape = "D,M"`` forces a 2-D
+    layout (e.g. "4,2" on 8 devices for data×model sharding).
+    """
+    cfg = cfg or global_settings
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if cfg.mesh_shape:
+        d, m = (int(x) for x in cfg.mesh_shape.split(","))
+        if d * m != n:
+            raise ValueError(
+                f"mesh_shape {cfg.mesh_shape} != device count {n}")
+    else:
+        d, m = n, 1
+    arr = mesh_utils.create_device_mesh((d, m), devices=devices)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
+    """Pad axis-0 to a multiple (static shapes for XLA); returns (padded, n).
+
+    Padding rows are zeros; compute masks them via ``row < n`` so results are
+    exact — the device-side analogue of the reference filtering out its
+    metadata row before compute (projection.py:105-110).
+    """
+    n = arr.shape[0]
+    pad = (-n) % multiple
+    if pad:
+        arr = np.concatenate(
+            [arr, np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)], axis=0)
+    return arr, n
+
+
+def shard_rows(mesh: Mesh, arr: np.ndarray) -> Tuple[jax.Array, int]:
+    """Place a host array on the mesh sharded along rows (data axis).
+
+    Returns the device array (rows padded to the data-axis size) and the
+    true row count for masking.
+    """
+    arr = np.asarray(arr)
+    n_shards = mesh.shape[DATA_AXIS]
+    padded, n = pad_rows(arr, n_shards)
+    spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
+    out = jax.device_put(padded, NamedSharding(mesh, spec))
+    return out, n
+
+
+def replicate(mesh: Mesh, x) -> jax.Array:
+    """Replicate a value across every mesh device (fully-replicated spec)."""
+    return jax.device_put(np.asarray(x), NamedSharding(mesh, P()))
+
+
+class MeshRuntime:
+    """Process-wide mesh holder (built lazily on first compute job).
+
+    The reference builds one SparkSession per request and tears it down
+    (model_builder.py:70-95,177); devices are persistent here, so the mesh is
+    built once and shared by every job in the server process.
+    """
+
+    def __init__(self, cfg: Optional[Settings] = None):
+        self.cfg = cfg or global_settings
+        self._lock = threading.Lock()
+        self._mesh: Optional[Mesh] = None
+
+    @property
+    def mesh(self) -> Mesh:
+        with self._lock:
+            if self._mesh is None:
+                self._mesh = local_mesh(self.cfg)
+            return self._mesh
+
+    def shard_rows(self, arr: np.ndarray) -> Tuple[jax.Array, int]:
+        return shard_rows(self.mesh, arr)
+
+    def replicate(self, x) -> jax.Array:
+        return replicate(self.mesh, x)
+
+
+_runtime: Optional[MeshRuntime] = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime(cfg: Optional[Settings] = None) -> MeshRuntime:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = MeshRuntime(cfg)
+        return _runtime

@@ -1,0 +1,2 @@
+from learningorchestra_tpu.serving.app import App  # noqa: F401
+from learningorchestra_tpu.serving.http import Server  # noqa: F401

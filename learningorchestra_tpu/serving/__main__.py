@@ -1,0 +1,37 @@
+"""``python -m learningorchestra_tpu.serving`` — run the service.
+
+Replaces the reference's per-service Flask ``app.run`` entrypoints + Docker
+Swarm stack (reference run.sh, docker-compose.yml). Multi-host TPU pods run
+this same module on every host; ``parallel.distributed.initialize`` joins
+them into one mesh (env: LO_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID).
+"""
+
+import argparse
+
+from learningorchestra_tpu.config import settings
+from learningorchestra_tpu.parallel import distributed
+from learningorchestra_tpu.serving.app import App
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="learningorchestra_tpu server")
+    parser.add_argument("--host", default=settings.host)
+    parser.add_argument("--port", type=int, default=settings.port)
+    parser.add_argument("--store-root", default=settings.store_root)
+    parser.add_argument("--no-recover", action="store_true",
+                        help="skip loading persisted datasets at startup")
+    args = parser.parse_args()
+
+    settings.host = args.host
+    settings.port = args.port
+    settings.store_root = args.store_root
+
+    distributed.initialize()
+    app = App(settings, recover=not args.no_recover)
+    print(f"learningorchestra_tpu serving on {args.host}:{args.port} "
+          f"(devices: {distributed.process_info()['devices']})", flush=True)
+    app.serve()
+
+
+if __name__ == "__main__":
+    main()

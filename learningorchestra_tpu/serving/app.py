@@ -1,0 +1,263 @@
+"""The service application: all 7 reference API surfaces on one server.
+
+The reference deploys 7 Flask microservices on ports 5000-5006 (client
+__init__.py:56-333; docker-compose.yml) — database_api, projection,
+data_type_handler, histogram, model_builder, tsne, pca. Here each becomes a
+router section of one process that embeds the engine (SURVEY.md §7: "one
+service binary with the same 7 API surfaces"); per-service ports are
+replaced by path prefixes. Status-code conventions follow the reference:
+201 for accepted creates, 406 invalid input, 409 duplicate, 404 missing
+(e.g. model_builder_image/server.py:52-115).
+
+Async contract preserved: creates return immediately; completion is
+observed by polling the dataset metadata ``finished`` flag (GET /files/...),
+exactly like the reference client does (client __init__.py:14-32) — with
+the upgrade that failed jobs set ``error`` and still flip ``finished``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from learningorchestra_tpu.catalog.ingest import ingest_csv_url
+from learningorchestra_tpu.catalog.store import (
+    DatasetExists, DatasetNotFound, DatasetStore)
+from learningorchestra_tpu.config import Settings, settings as global_settings
+from learningorchestra_tpu.jobs import JobManager
+from learningorchestra_tpu.models.builder import ModelBuilder
+from learningorchestra_tpu.ops.dtypes import convert_fields
+from learningorchestra_tpu.ops.histogram import create_histogram
+from learningorchestra_tpu.ops.projection import create_projection
+from learningorchestra_tpu.parallel import distributed
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.serving.http import (
+    FileResponse, HttpError, Router, Server)
+from learningorchestra_tpu.viz.service import (
+    ImageExists, ImageNotFound, ImageService, create_embedding_image)
+
+
+class App:
+    def __init__(self, cfg: Optional[Settings] = None, recover: bool = True):
+        self.cfg = cfg or global_settings
+        self.store = DatasetStore(self.cfg)
+        if recover and self.cfg.persist:
+            self.store.load_all()
+        self.runtime = MeshRuntime(self.cfg)
+        self.jobs = JobManager(self.store)
+        self.builder = ModelBuilder(self.store, self.runtime, self.cfg)
+        self.images = {m: ImageService(m, self.cfg) for m in ("tsne", "pca")}
+        self.router = Router()
+        self._register()
+
+    # -- helpers -------------------------------------------------------------
+
+    def _wrap(self, fn):
+        """Translate domain exceptions to the reference's status codes."""
+
+        def inner(req):
+            try:
+                return fn(req)
+            except DatasetNotFound as e:
+                raise HttpError(404, f"dataset not found: {e}")
+            except ImageNotFound as e:
+                raise HttpError(404, f"image not found: {e}")
+            except (DatasetExists, ImageExists) as e:
+                raise HttpError(409, f"duplicate: {e}")
+            except KeyError as e:
+                raise HttpError(404, str(e))
+            except PermissionError as e:
+                raise HttpError(403, str(e))
+            except ValueError as e:
+                raise HttpError(406, str(e))
+
+        return inner
+
+    def _route(self, method: str, pattern: str):
+        def deco(fn):
+            return self.router.route(method, pattern)(self._wrap(fn))
+
+        return deco
+
+    # -- routes --------------------------------------------------------------
+
+    def _register(self) -> None:
+        app = self
+
+        # ---- database_api (reference database_api_image/server.py:33-96)
+        @self._route("POST", "/files")
+        def create_file(req):
+            filename, url = req.require("filename", "url")
+            app.store.create(filename, url=url)
+            app.jobs.submit(
+                "ingest", filename,
+                lambda: ingest_csv_url(app.store, filename, url, app.cfg))
+            return 201, {"result": f"file {filename} created",
+                         "filename": filename}
+
+        @self._route("GET", "/files")
+        def list_files(_req):
+            return 200, app.store.metadata_docs()
+
+        @self._route("GET", "/files/{name}")
+        def read_file(req):
+            limit = min(req.q("limit", 10, int), app.cfg.read_limit_cap)
+            skip = req.q("skip", 0, int)
+            query = req.q("query")
+            query = json.loads(query) if query else {}
+            return 200, app.store.read(req.params["name"], skip=skip,
+                                       limit=limit, query=query)
+
+        @self._route("DELETE", "/files/{name}")
+        def delete_file(req):
+            app.store.delete(req.params["name"])
+            return 200, {"result": "deleted"}
+
+        # ---- projection (reference projection_image/server.py:50-115)
+        @self._route("POST", "/projections/{parent}")
+        def projection(req):
+            parent = req.params["parent"]
+            name, fields = req.require("projection_filename", "fields")
+            if not app.store.exists(parent):
+                raise DatasetNotFound(parent)
+            # Validate fields synchronously (reference returns 406 inline).
+            parent_fields = app.store.get(parent).metadata.fields
+            missing = [f for f in fields if f not in parent_fields]
+            if missing:
+                raise ValueError(f"fields not in dataset: {missing}")
+            app.store.create(name, parent=parent)
+            app.jobs.submit(
+                "projection", name,
+                lambda: create_projection(app.store, parent, name, fields,
+                                          existing=True))
+            return 201, {"result": f"projection {name} created"}
+
+        # ---- histogram (reference histogram_image/server.py)
+        @self._route("POST", "/histograms/{parent}")
+        def histogram(req):
+            parent = req.params["parent"]
+            name, fields = req.require("histogram_filename", "fields")
+            if not app.store.exists(parent):
+                raise DatasetNotFound(parent)
+            parent_fields = app.store.get(parent).metadata.fields
+            missing = [f for f in fields if f not in parent_fields]
+            if missing:
+                raise ValueError(f"fields not in dataset: {missing}")
+            app.store.create(name, parent=parent)
+            app.jobs.submit(
+                "histogram", name,
+                lambda: create_histogram(app.store, app.runtime, parent,
+                                         name, fields, existing=True))
+            return 201, {"result": f"histogram {name} created"}
+
+        # ---- data_type_handler (reference data_type_handler server.py:46-76)
+        @self._route("PATCH", "/fieldtypes/{name}")
+        def fieldtypes(req):
+            convert_fields(app.store, req.params["name"], req.body)
+            return 200, {"result": "types converted"}
+
+        # ---- model_builder (reference model_builder_image/server.py:52-115)
+        @self._route("POST", "/models")
+        def models(req):
+            (train, test, pred_name, classifiers, label) = req.require(
+                "training_filename", "test_filename", "prediction_filename",
+                "classificators_list", "label")
+            steps = req.body.get("steps", ())
+            code = req.body.get("preprocessor_code")
+            hparams = req.body.get("hparams")
+            sync = bool(req.body.get("sync", True))
+            app.builder.validate(train, test, classifiers, pred_name)
+
+            if sync:
+                # The reference's POST /models blocks until all fits finish
+                # (SURVEY.md §3.2 "synchronous 201").
+                reports = app.builder.build(train, test, pred_name,
+                                            classifiers, label, steps=steps,
+                                            preprocessor_code=code,
+                                            hparams=hparams)
+                return 201, {"result": [
+                    {"classifier": r.kind, "fit_time": r.fit_time,
+                     **r.metrics} for r in reports]}
+
+            def run():
+                app.builder.build(train, test, pred_name, classifiers, label,
+                                  steps=steps, preprocessor_code=code,
+                                  hparams=hparams)
+
+            app.jobs.submit("model_builder", f"{pred_name}_{classifiers[0]}",
+                            run)
+            return 201, {"result": "model build started",
+                         "prediction_datasets": [
+                             f"{pred_name}_{c}" for c in classifiers]}
+
+        # ---- tsne / pca images (reference tsne_image/server.py:57-155)
+        for method in ("tsne", "pca"):
+            self._register_images(method)
+
+        # ---- observability (upgrade; reference exposed Spark UIs only)
+        @self._route("GET", "/cluster")
+        def cluster(_req):
+            info = distributed.process_info()
+            info["mesh"] = dict(app.runtime.mesh.shape)
+            return 200, info
+
+        @self._route("GET", "/jobs")
+        def jobs(_req):
+            return 200, app.jobs.records()
+
+    def _register_images(self, method: str) -> None:
+        app = self
+        svc = self.images[method]
+
+        @self._route("POST", f"/{method}/images/{{parent}}")
+        def create_image(req, method=method, svc=svc):
+            name = req.body.get("image_name") or req.body.get(
+                f"{method}_filename")
+            if not name:
+                raise ValueError("missing image_name")
+            label = req.body.get("label_name")
+            svc.validate_new(name)
+            if not app.store.exists(req.params["parent"]):
+                raise DatasetNotFound(req.params["parent"])
+            parent = req.params["parent"]
+            # Validate label synchronously like the reference (tsne.py:154-186)
+            if label is not None and label not in app.store.get(
+                    parent).metadata.fields:
+                raise ValueError(f"label field not in dataset: {label}")
+            marker = f"img.{method}.{name}"
+            app.store.create(marker, parent=parent)
+            kwargs = {k: req.body[k] for k in
+                      ("perplexity", "iters") if k in req.body}
+
+            def run():
+                create_embedding_image(app.store, app.runtime, method,
+                                       parent, name, label=label,
+                                       image_root=app.cfg.image_root,
+                                       **kwargs)
+                app.store.finish(marker)
+
+            app.jobs.submit(f"{method}_image", marker, run)
+            return 201, {"result": f"{method} image {name} started",
+                         "poll": marker}
+
+        @self._route("GET", f"/{method}/images")
+        def list_images(_req, svc=svc):
+            return 200, svc.list_names()
+
+        @self._route("GET", f"/{method}/images/{{name}}")
+        def get_image(req, svc=svc):
+            return 200, FileResponse(svc.get_path(req.params["name"]))
+
+        @self._route("DELETE", f"/{method}/images/{{name}}")
+        def delete_image(req, svc=svc):
+            svc.delete(req.params["name"])
+            return 200, {"result": "deleted"}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def serve(self, background: bool = False) -> Server:
+        server = Server(self.router, self.cfg.host, self.cfg.port)
+        if background:
+            return server.start_background()
+        server.serve_forever()
+        return server

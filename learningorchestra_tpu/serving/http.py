@@ -1,0 +1,175 @@
+"""Minimal threaded HTTP/JSON framework on the Python stdlib.
+
+The reference runs 7 separate Flask apps, one per microservice, each with
+its own port and copy-pasted error mapping (reference
+microservices/*/server.py). This framework provides the same request
+surface — JSON bodies, query params, path params, file responses, and the
+406/409/404 error mapping convention (e.g. model_builder_image/
+server.py:52-115) — in ~150 lines with no third-party dependency, served by
+``ThreadingHTTPServer`` so long-running jobs never block other requests.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, method: str, path: str, params: Dict[str, str],
+                 query: Dict[str, List[str]], body: Optional[Dict[str, Any]]):
+        self.method = method
+        self.path = path
+        self.params = params
+        self.query = query
+        self.body = body or {}
+
+    def q(self, name: str, default=None, cast=None):
+        vals = self.query.get(name)
+        if not vals:
+            return default
+        return cast(vals[0]) if cast else vals[0]
+
+    def require(self, *names: str) -> List[Any]:
+        out = []
+        for n in names:
+            if n not in self.body:
+                raise HttpError(400, f"missing required field: {n}")
+            out.append(self.body[n])
+        return out
+
+
+class FileResponse:
+    def __init__(self, path: str, content_type: str = "image/png"):
+        self.path = path
+        self.content_type = content_type
+
+
+class Router:
+    def __init__(self):
+        self._routes: List[Tuple[str, re.Pattern, Callable]] = []
+
+    def route(self, method: str, pattern: str):
+        """Register ``pattern`` like "/files/{name}"."""
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+
+        def deco(fn):
+            self._routes.append((method.upper(), regex, fn))
+            return fn
+
+        return deco
+
+    def dispatch(self, req_method: str, url: str,
+                 body: Optional[Dict]) -> Tuple[int, Any]:
+        parsed = urlparse(url)
+        for method, regex, fn in self._routes:
+            if method != req_method:
+                continue
+            m = regex.match(parsed.path)
+            if not m:
+                continue
+            req = Request(req_method, parsed.path, m.groupdict(),
+                          parse_qs(parsed.query), body)
+            return fn(req)
+        raise HttpError(404, f"no route: {req_method} {parsed.path}")
+
+
+def _make_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _read_body(self) -> Optional[Dict]:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return None
+            raw = self.rfile.read(length)
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError:
+                raise HttpError(400, "invalid JSON body")
+
+        def _send_json(self, status: int, payload: Any) -> None:
+            data = json.dumps(payload, default=str).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_file(self, resp: FileResponse) -> None:
+            with open(resp.path, "rb") as f:
+                data = f.read()
+            self.send_response(200)
+            self.send_header("Content-Type", resp.content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _handle(self, method: str) -> None:
+            try:
+                body = self._read_body()
+                status, payload = router.dispatch(method, self.path, body)
+                if isinstance(payload, FileResponse):
+                    self._send_file(payload)
+                else:
+                    self._send_json(status, payload)
+            except HttpError as e:
+                self._send_json(e.status, {"result": e.message})
+            except Exception as e:  # noqa: BLE001 — request boundary
+                traceback.print_exc()
+                self._send_json(500, {"result": f"internal error: {e}"})
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_PATCH(self):
+            self._handle("PATCH")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+    return Handler
+
+
+class Server:
+    """Threaded HTTP server wrapper with programmatic start/stop (tests run
+    it in-process; production runs it via ``python -m
+    learningorchestra_tpu.serving``)."""
+
+    def __init__(self, router: Router, host: str, port: int):
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(router))
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start_background(self) -> "Server":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="lo-http")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

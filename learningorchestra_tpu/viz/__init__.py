@@ -1,0 +1,2 @@
+from learningorchestra_tpu.viz.service import (  # noqa: F401
+    ImageService, create_embedding_image)

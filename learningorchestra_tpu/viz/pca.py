@@ -1,0 +1,44 @@
+"""PCA embedding — device-side, mesh-scalable.
+
+The reference's pca microservice Spark-loads the collection then runs
+single-node ``sklearn.decomposition.PCA(n_components=2)`` on the driver
+(reference pca.py:74-98) — the gather-to-driver cliff SURVEY.md §3.4 calls
+out. TPU-native design: the d×d Gram matrix is one MXU contraction over the
+row-sharded design matrix (XLA all-reduces the sharded row axis over ICI),
+and the eigendecomposition of that tiny matrix runs on device — no row data
+ever leaves the devices, so HIGGS-11M (11M × 28) costs one pass of
+streaming matmul instead of an 11M-row driver collect.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _pca_project(X, n_valid, *, k):
+    n, d = X.shape
+    mask = (jnp.arange(n) < n_valid)[:, None].astype(jnp.float32)
+    Xm = X * mask
+    count = jnp.maximum(n_valid.astype(jnp.float32), 1.0)
+    mean = Xm.sum(axis=0) / count
+    Xc = (X - mean) * mask
+    cov = (Xc.T @ Xc) / count                  # (d, d) — MXU + ICI psum
+    evals, evecs = jnp.linalg.eigh(cov)        # ascending
+    comps = evecs[:, ::-1][:, :k]              # top-k components (d, k)
+    var = evals[::-1][:k]
+    return Xc @ comps, var
+
+
+def pca_embed(runtime: MeshRuntime, X: np.ndarray,
+              k: int = 2) -> np.ndarray:
+    """(n, d) host matrix → (n, k) principal-component embedding."""
+    X_dev, n = runtime.shard_rows(np.asarray(X, np.float32))
+    emb, _ = _pca_project(X_dev, runtime.replicate(np.int32(n)), k=k)
+    return np.asarray(emb)[:n]

@@ -1,0 +1,179 @@
+// Native CSV tokenizer for the ingest pipeline.
+//
+// The reference's ingest hot loop is pure Python: one thread turning each
+// CSV line into a dict, one Mongo insert per row (reference
+// database_api_image/database.py:156-181). This framework's native tier is
+// first-party C++ (the reference's native horsepower was the external Spark
+// JVM — SURVEY.md §2): a single-pass, RFC-4180-aware tokenizer that
+// classifies each column as numeric or string and materializes numeric
+// columns directly into contiguous double buffers that numpy adopts without
+// copying per cell. Exposed as a C ABI for ctypes
+// (learningorchestra_tpu/catalog/native.py).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Column {
+  std::string name;
+  bool numeric = true;
+  std::vector<double> nums;           // valid when numeric
+  std::vector<std::string> strs;      // always filled (fallback storage)
+};
+
+struct Table {
+  std::vector<Column> cols;
+  int64_t nrows = 0;
+};
+
+// Parse one CSV record starting at p (end at stop). Appends cell strings to
+// out. Returns pointer past the record's newline (or stop). Handles quoted
+// fields with embedded commas/newlines and doubled-quote escapes.
+const char* parse_record(const char* p, const char* stop,
+                         std::vector<std::string>& out) {
+  std::string cell;
+  bool in_quotes = false;
+  for (;;) {
+    if (p == stop) {
+      out.push_back(cell);
+      return p;
+    }
+    char c = *p;
+    if (in_quotes) {
+      if (c == '"') {
+        if (p + 1 < stop && p[1] == '"') {  // escaped quote
+          cell.push_back('"');
+          p += 2;
+        } else {
+          in_quotes = false;
+          ++p;
+        }
+      } else {
+        cell.push_back(c);
+        ++p;
+      }
+    } else if (c == '"') {
+      in_quotes = true;
+      ++p;
+    } else if (c == ',') {
+      out.push_back(cell);
+      cell.clear();
+      ++p;
+    } else if (c == '\n' || c == '\r') {
+      if (c == '\r' && p + 1 < stop && p[1] == '\n') ++p;
+      ++p;
+      out.push_back(cell);
+      return p;
+    } else {
+      cell.push_back(c);
+      ++p;
+    }
+  }
+}
+
+// strtod-based full-string numeric check; empty cells are NaN (missing).
+bool to_double(const std::string& s, double* out) {
+  if (s.empty()) {
+    *out = std::strtod("nan", nullptr);
+    return true;
+  }
+  const char* c = s.c_str();
+  char* end = nullptr;
+  double v = std::strtod(c, &end);
+  while (*end == ' ') ++end;
+  if (end == c || *end != '\0') return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a CSV byte buffer. Returns an opaque Table* (NULL on failure).
+void* lo_csv_parse(const char* data, size_t len, int has_header) {
+  const char* p = data;
+  const char* stop = data + len;
+  auto* table = new Table();
+
+  std::vector<std::string> cells;
+  if (has_header) {
+    if (p == stop) { delete table; return nullptr; }
+    p = parse_record(p, stop, cells);
+    for (auto& name : cells) {
+      Column col;
+      col.name = name;
+      table->cols.push_back(std::move(col));
+    }
+  }
+
+  size_t width = table->cols.size();
+  while (p != stop) {
+    // Skip blank lines.
+    if (*p == '\n' || *p == '\r') { ++p; continue; }
+    cells.clear();
+    p = parse_record(p, stop, cells);
+    if (width == 0) {  // headerless: synthesize c0..cN on first record
+      width = cells.size();
+      for (size_t i = 0; i < width; ++i) {
+        Column col;
+        col.name = "c" + std::to_string(i);
+        table->cols.push_back(std::move(col));
+      }
+    }
+    if (cells.size() != width) {  // ragged row: pad/truncate to width
+      cells.resize(width);
+    }
+    for (size_t i = 0; i < width; ++i) {
+      Column& col = table->cols[i];
+      double v;
+      if (col.numeric && to_double(cells[i], &v)) {
+        col.nums.push_back(v);
+      } else if (col.numeric) {
+        // Column demoted to string: discard numeric buffer (strings were
+        // kept all along).
+        col.numeric = false;
+        col.nums.clear();
+        col.nums.shrink_to_fit();
+      }
+      col.strs.push_back(std::move(cells[i]));
+    }
+    table->nrows++;
+  }
+  return table;
+}
+
+int lo_csv_ncols(void* handle) {
+  return static_cast<int>(static_cast<Table*>(handle)->cols.size());
+}
+
+long lo_csv_nrows(void* handle) {
+  return static_cast<long>(static_cast<Table*>(handle)->nrows);
+}
+
+const char* lo_csv_col_name(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].name.c_str();
+}
+
+int lo_csv_col_is_numeric(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].numeric ? 1 : 0;
+}
+
+// Contiguous double buffer of a numeric column (owned by the Table).
+double* lo_csv_col_numeric(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].nums.data();
+}
+
+const char* lo_csv_cell_str(void* handle, int c, long r) {
+  return static_cast<Table*>(handle)->cols[c].strs[r].c_str();
+}
+
+void lo_csv_free(void* handle) { delete static_cast<Table*>(handle); }
+
+}  // extern "C"

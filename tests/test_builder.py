@@ -1,0 +1,142 @@
+"""ModelBuilder end-to-end tests on a Titanic-like dataset (the reference's
+de-facto smoke test, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.models.builder import ModelBuilder
+from learningorchestra_tpu.ops.preprocess import apply_steps, design_matrix
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    return MeshRuntime(Settings())
+
+
+def _titanic_like(store, name, n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    pclass = rng.integers(1, 4, n)
+    sex = rng.choice(["male", "female"], n)
+    age = rng.normal(30, 12, n)
+    age[rng.random(n) < 0.15] = np.nan  # missing ages like the real set
+    fare = rng.lognormal(2.5, 1.0, n)
+    logit = 1.5 * (sex == "female") - 0.5 * pclass + 0.01 * fare - 0.3
+    surv = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.int64)
+    store.create(name, columns={
+        "Pclass": pclass.astype(np.int64),
+        "Sex": np.array(sex, dtype=object),
+        "Age": age, "Fare": fare, "Survived": surv}, finished=True)
+
+
+def test_design_matrix_default_pipeline(store):
+    _titanic_like(store, "train")
+    ds = store.get("train")
+    X, y, fields, state = design_matrix(ds, "Survived")
+    assert X.shape == (400, 4)
+    assert not np.isnan(X).any()          # mean-fill applied
+    assert set(fields) == {"Pclass", "Sex", "Age", "Fare"}
+    assert y.dtype == np.int32
+    # same pipeline on "test" reuses fitted state (vocab + fill values)
+    _titanic_like(store, "test", n=100, seed=1)
+    X2, y2, _, _ = design_matrix(store.get("test"), "Survived",
+                                 state=state, feature_fields=fields)
+    assert X2.shape == (100, 4) and not np.isnan(X2).any()
+
+
+def test_apply_steps_select_drop_standardize():
+    cols = {"a": np.arange(10, dtype=np.float64),
+            "b": np.arange(10, dtype=np.float64) * 3,
+            "s": np.array(["x", "y"] * 5, dtype=object)}
+    out, state = apply_steps(cols, [
+        {"op": "drop", "fields": ["b"]},
+        {"op": "label_encode", "fields": ["s"]},
+        {"op": "standardize"}])
+    assert set(out) == {"a", "s"}
+    assert abs(out["a"].mean()) < 1e-9
+    # test-time application reuses train stats
+    out2, _ = apply_steps(cols, [
+        {"op": "drop", "fields": ["b"]},
+        {"op": "label_encode", "fields": ["s"]},
+        {"op": "standardize"}], state=state)
+    np.testing.assert_allclose(out2["a"], out["a"])
+
+
+def test_build_five_classifiers(store, runtime, cfg):
+    _titanic_like(store, "train")
+    _titanic_like(store, "test", n=120, seed=2)
+    mb = ModelBuilder(store, runtime, cfg)
+    classifiers = ["lr", "dt", "rf", "gb", "nb"]
+    mb.validate("train", "test", classifiers, "pred")
+    reports = mb.build("train", "test", "pred", classifiers, "Survived")
+    assert len(reports) == 5
+    for r in reports:
+        assert r.fit_time > 0
+        assert r.metrics.get("accuracy", 0) > 0.6, r
+        ds = store.get(f"pred_{r.kind}")
+        doc = ds.metadata.to_doc()
+        assert doc["finished"] is True
+        assert doc["parent_filename"] == "test"
+        assert 0 < doc["f1"] <= 1 and 0 < doc["accuracy"] <= 1
+        assert doc["fit_time"] > 0
+        # prediction rows: test columns + prediction + probability list
+        row = ds.rows(np.arange(1))[0]
+        assert "prediction" in row and "probability" in row
+        assert len(row["probability"]) == 2
+        assert ds.num_rows == 120
+
+
+def test_build_validation_errors(store, runtime, cfg):
+    _titanic_like(store, "train")
+    mb = ModelBuilder(store, runtime, cfg)
+    with pytest.raises(KeyError):
+        mb.validate("train", "missing", ["lr"], "p")
+    with pytest.raises(ValueError, match="invalid classifier"):
+        mb.validate("train", "train", ["svm"], "p")
+
+
+def test_build_failed_classifier_marks_dataset(store, runtime, cfg):
+    """gb on a 3-class label must fail its dataset but not the others."""
+    rng = np.random.default_rng(0)
+    for name in ("tr3", "te3"):
+        store.create(name, columns={
+            "x": rng.normal(size=100), "y2": rng.normal(size=100),
+            "lab": rng.integers(0, 3, 100).astype(np.int64)}, finished=True)
+    mb = ModelBuilder(store, runtime, cfg)
+    reports = mb.build("tr3", "te3", "p3", ["gb", "nb"], "lab")
+    by_kind = {r.kind: r for r in reports}
+    assert "error" in by_kind["gb"].metrics
+    assert store.get("p3_gb").metadata.error is not None
+    assert store.get("p3_nb").metadata.finished is True
+    assert store.get("p3_nb").metadata.error is None
+
+
+def test_exec_preprocess_gated(store, runtime, cfg):
+    _titanic_like(store, "train")
+    _titanic_like(store, "test", n=50, seed=3)
+    mb = ModelBuilder(store, runtime, cfg)
+    with pytest.raises(PermissionError):
+        mb.build("train", "test", "pe", ["nb"], "Survived",
+                 preprocessor_code="features_training = 1")
+
+
+def test_exec_preprocess_enabled(store, runtime, cfg):
+    cfg.allow_exec_preprocessing = True
+    _titanic_like(store, "train")
+    _titanic_like(store, "test", n=50, seed=3)
+    mb = ModelBuilder(store, runtime, cfg)
+    code = """
+import numpy as np
+def prep(df):
+    X = df[["Pclass", "Fare"]].to_numpy(dtype="float32")
+    X = np.nan_to_num(X)
+    return X
+features_training = prep(training_df)
+labels_training = training_df["Survived"].to_numpy()
+features_testing = prep(testing_df)
+labels_testing = testing_df["Survived"].to_numpy()
+"""
+    reports = mb.build("train", "test", "pe", ["nb"], "Survived",
+                       preprocessor_code=code)
+    assert reports[0].metrics["accuracy"] > 0.4

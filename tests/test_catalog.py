@@ -166,3 +166,38 @@ def test_concurrent_append_and_read():
     for t in threads:
         t.join()
     assert ds.num_rows == n_chunks * rows
+
+
+def test_dataset_name_validation(store):
+    for bad in ("../evil", "a/b", "", ".hidden", "a\x00b", "x/../../y"):
+        with pytest.raises(ValueError, match="invalid dataset name"):
+            store.create(bad)
+    store.create("ok_Name-1.2")  # valid
+
+
+def test_read_limit_zero_and_small(store):
+    import numpy as np
+    store.create("lz", columns={"a": np.arange(10)}, finished=True)
+    assert store.read("lz", limit=0) == []
+    assert [d["_id"] for d in store.read("lz", limit=1)] == [0]
+    assert [d["_id"] for d in store.read("lz", limit=2)] == [0, 1]
+
+
+def test_chunk_dtype_conflict_stringifies():
+    """A column numeric in early chunks but string later must become one
+    consistent string domain (as a whole-file parse would)."""
+    import numpy as np
+    from learningorchestra_tpu.catalog.dataset import Dataset, Metadata
+    ds = Dataset(Metadata(name="c"))
+    ds.append_columns({"code": np.array([5, 7], dtype=np.int64)})
+    ds.append_columns({"code": np.array(["N/A", "9"], dtype=object)})
+    col = ds.column("code")
+    assert col.tolist() == ["5", "7", "N/A", "9"]
+
+
+def test_set_column_atomic_length_check():
+    import numpy as np
+    from learningorchestra_tpu.catalog.dataset import Dataset, Metadata
+    ds = Dataset(Metadata(name="s"), {"a": np.arange(4)})
+    with pytest.raises(ValueError, match="column length"):
+        ds.set_column("a", np.arange(3))

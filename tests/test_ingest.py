@@ -92,3 +92,61 @@ def test_ingest_backpressure_pipeline(store, cfg, tmp_path):
     t.join(timeout=30)
     assert not t.is_alive()
     assert store.get("bp").num_rows == n
+
+
+# -- native C++ parser ------------------------------------------------------
+
+def _native_or_skip():
+    from learningorchestra_tpu.catalog import native
+    if not native.available():
+        pytest.skip("native parser not built (make -C native)")
+    return native
+
+
+def test_native_parse_matches_pandas():
+    native = _native_or_skip()
+    data = b"a,b,s\n1,2.5,x\n3,,y\n-4,1e3,\n"
+    cols = native.parse_csv_bytes(data)
+    assert cols["a"].dtype.kind == "i"
+    assert cols["a"].tolist() == [1, 3, -4]
+    assert cols["b"].dtype.kind == "f"
+    assert cols["b"][0] == 2.5 and np.isnan(cols["b"][1]) and cols["b"][2] == 1000.0
+    assert cols["s"].tolist() == ["x", "y", None]
+
+
+def test_native_quoted_fields():
+    native = _native_or_skip()
+    data = b'id,text\n1,"hello, world"\n2,"line1\nline2"\n3,"she said ""hi"""\n'
+    cols = native.parse_csv_bytes(data)
+    assert cols["id"].tolist() == [1, 2, 3]
+    assert cols["text"].tolist() == ["hello, world", "line1\nline2",
+                                    'she said "hi"']
+
+
+def test_native_chunked_stream_with_quoted_newlines():
+    native = _native_or_skip()
+    import io
+    rows = ["t,v"]
+    for i in range(500):
+        rows.append(f'"row\n{i}",{i}')
+    stream = io.BytesIO(("\n".join(rows) + "\n").encode())
+    total = 0
+    vals = []
+    for cols in native.parse_csv_chunks(io.BufferedReader(stream), 64):
+        total += len(cols["v"])
+        vals.extend(cols["v"].tolist())
+    assert total == 500
+    assert vals == list(range(500))
+
+
+def test_native_ingest_end_to_end(store, cfg, tmp_path):
+    _native_or_skip()
+    cfg.use_native_csv = True
+    p = tmp_path / "n.csv"
+    p.write_text(CSV)
+    store.create("nat", url=str(p))
+    ingest_csv_url(store, "nat", str(p), cfg)
+    ds = store.get("nat")
+    assert ds.num_rows == 3
+    assert ds.column("age").tolist() == [22, 38, 26]
+    assert ds.column("name")[2] == "allen"

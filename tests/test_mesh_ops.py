@@ -1,0 +1,120 @@
+"""Mesh runtime + analytics ops tests on the simulated 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.ops.dtypes import convert_fields
+from learningorchestra_tpu.ops.histogram import create_histogram, field_counts
+from learningorchestra_tpu.ops.projection import create_projection
+from learningorchestra_tpu.parallel.mesh import (
+    DATA_AXIS, MeshRuntime, local_mesh, pad_rows, shard_rows)
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    return MeshRuntime(Settings())
+
+
+def test_mesh_uses_all_devices(runtime):
+    assert runtime.mesh.shape[DATA_AXIS] == 8
+
+
+def test_mesh_shape_override():
+    cfg = Settings()
+    cfg.mesh_shape = "4,2"
+    mesh = local_mesh(cfg)
+    assert mesh.shape == {"data": 4, "model": 2}
+
+
+def test_pad_and_shard(runtime):
+    x = np.arange(13, dtype=np.float32).reshape(13, 1)
+    arr, n = shard_rows(runtime.mesh, x)
+    assert n == 13
+    assert arr.shape[0] % 8 == 0
+    assert np.asarray(arr)[:13, 0].tolist() == list(range(13))
+
+
+def test_pad_rows_exact_multiple():
+    x = np.ones((16, 2))
+    padded, n = pad_rows(x, 8)
+    assert padded.shape == (16, 2) and n == 16
+
+
+def test_mesh_bincount_matches_numpy(runtime):
+    rng = np.random.default_rng(0)
+    col = rng.integers(0, 50, size=1003).astype(np.int64)
+    counts = field_counts(runtime, col)
+    expect = {int(v): int(c) for v, c in
+              zip(*np.unique(col, return_counts=True))}
+    assert counts == expect
+
+
+def test_field_counts_negative_ints(runtime):
+    col = np.array([-3, -3, 0, 2, 2, 2], dtype=np.int64)
+    assert field_counts(runtime, col) == {-3: 2, 0: 1, 2: 3}
+
+
+def test_field_counts_strings_and_floats(runtime):
+    col = np.array(["a", "b", "a", None], dtype=object)
+    assert field_counts(runtime, col) == {"a": 2, "b": 1, None: 1}
+    col = np.array([1.5, 1.5, np.nan])
+    assert field_counts(runtime, col) == {1.5: 2, None: 1}
+
+
+def test_histogram_op(store, runtime):
+    store.create("src", columns={
+        "cls": np.array([1, 2, 1, 3, 1], dtype=np.int64),
+        "name": np.array(list("abcda"), dtype=object)}, finished=True)
+    create_histogram(store, runtime, "src", "hist", ["cls", "name"])
+    ds = store.get("hist")
+    assert ds.metadata.finished is True
+    assert ds.metadata.parent == "src"
+    rows = ds.rows(np.arange(2))
+    assert rows[0]["field"] == "cls"
+    assert rows[0]["counts"] == {1: 3, 2: 1, 3: 1}
+    assert rows[1]["counts"] == {"a": 2, "b": 1, "c": 1, "d": 1}
+
+
+def test_histogram_validates_fields(store, runtime):
+    store.create("src", columns={"a": np.arange(3)}, finished=True)
+    with pytest.raises(ValueError, match="not in dataset"):
+        create_histogram(store, runtime, "src", "h", ["nope"])
+
+
+def test_projection_op(store):
+    store.create("src", columns={
+        "a": np.arange(4), "b": np.arange(4) * 2.0,
+        "c": np.array(list("wxyz"), dtype=object)}, finished=True)
+    create_projection(store, "src", "proj", ["a", "c"])
+    ds = store.get("proj")
+    assert ds.metadata.fields == ["a", "c"]
+    assert ds.metadata.parent == "src"
+    assert ds.num_rows == 4
+    with pytest.raises(ValueError, match="not in dataset"):
+        create_projection(store, "src", "p2", ["a", "missing"])
+
+
+def test_dtype_conversion_roundtrip(store):
+    store.create("d", columns={
+        "num_str": np.array(["1", "2.5", "", None], dtype=object),
+        "ints": np.array([1, 2, 3, 4], dtype=np.int64)}, finished=True)
+    convert_fields(store, "d", {"num_str": "number", "ints": "string"})
+    ds = store.get("d")
+    col = ds.column("num_str")
+    assert col.dtype.kind == "f"
+    assert col[0] == 1.0 and col[1] == 2.5
+    assert np.isnan(col[2]) and np.isnan(col[3])
+    assert ds.column("ints").tolist() == ["1", "2", "3", "4"]
+    # back to number; integral floats become ints
+    convert_fields(store, "d", {"ints": "number"})
+    assert ds.column("ints").dtype.kind == "i"
+
+
+def test_dtype_conversion_errors(store):
+    store.create("d", columns={"s": np.array(["x"], dtype=object)},
+                 finished=True)
+    with pytest.raises(ValueError, match="invalid type"):
+        convert_fields(store, "d", {"s": "banana"})
+    with pytest.raises(ValueError, match="not convertible"):
+        convert_fields(store, "d", {"s": "number"})

@@ -1,0 +1,132 @@
+"""Trainer numerics + ModelBuilder tests on the 8-device CPU mesh.
+
+Parity strategy per SURVEY.md §4: every family must beat a sanity floor on a
+separable synthetic task, and lr/nb/dt/rf are cross-checked against sklearn
+on the same data (the reference's only published metrics are Titanic
+F1≈0.703 / acc≈0.703 for nb — our floors are set well above chance and near
+sklearn's result)."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.models.metrics import classification_metrics
+from learningorchestra_tpu.models.registry import CLASSIFIERS, get_trainer
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    return MeshRuntime(Settings())
+
+
+def _blobs(n=600, d=6, classes=2, seed=0, sep=2.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * sep
+    y = rng.integers(0, classes, size=n)
+    X = centers[y] + rng.normal(size=(n, d))
+    return X.astype(np.float32), y.astype(np.int32)
+
+
+def _split(X, y, frac=0.25):
+    n_test = int(len(X) * frac)
+    return X[n_test:], y[n_test:], X[:n_test], y[:n_test]
+
+
+def _acc(runtime, model, X, y):
+    preds = model.predict(runtime, X)
+    return float((preds == y).mean())
+
+
+@pytest.mark.parametrize("kind", sorted(CLASSIFIERS))
+def test_trainer_beats_floor_binary(runtime, kind):
+    X, y = _blobs(n=600, classes=2)
+    Xtr, ytr, Xte, yte = _split(X, y)
+    model = get_trainer(kind)(runtime, Xtr, ytr, 2)
+    assert _acc(runtime, model, Xte, yte) > 0.9, kind
+
+
+@pytest.mark.parametrize("kind", ["lr", "nb", "dt", "rf", "mlp"])
+def test_trainer_multiclass(runtime, kind):
+    X, y = _blobs(n=900, classes=3, sep=3.0)
+    Xtr, ytr, Xte, yte = _split(X, y)
+    model = get_trainer(kind)(runtime, Xtr, ytr, 3)
+    assert _acc(runtime, model, Xte, yte) > 0.85, kind
+
+
+def test_gb_rejects_multiclass(runtime):
+    X, y = _blobs(n=90, classes=3)
+    with pytest.raises(ValueError, match="binary"):
+        get_trainer("gb")(runtime, X, y, 3)
+
+
+def test_unknown_classifier():
+    with pytest.raises(ValueError, match="invalid classifier"):
+        get_trainer("xgboost")
+
+
+def test_lr_matches_sklearn(runtime):
+    from sklearn.linear_model import LogisticRegression
+
+    X, y = _blobs(n=800, classes=2, sep=1.2)
+    Xtr, ytr, Xte, yte = _split(X, y)
+    ours = get_trainer("lr")(runtime, Xtr, ytr, 2)
+    sk = LogisticRegression(max_iter=1000).fit(Xtr, ytr)
+    ours_acc = _acc(runtime, ours, Xte, yte)
+    sk_acc = float((sk.predict(Xte) == yte).mean())
+    assert ours_acc >= sk_acc - 0.03
+
+
+def test_nb_matches_sklearn(runtime):
+    from sklearn.naive_bayes import GaussianNB
+
+    X, y = _blobs(n=800, classes=2, sep=1.2)
+    Xtr, ytr, Xte, yte = _split(X, y)
+    ours = get_trainer("nb")(runtime, Xtr, ytr, 2)
+    sk = GaussianNB().fit(Xtr, ytr)
+    assert _acc(runtime, ours, Xte, yte) >= \
+        float((sk.predict(Xte) == yte).mean()) - 0.03
+
+
+def test_dt_matches_sklearn(runtime):
+    from sklearn.tree import DecisionTreeClassifier
+
+    X, y = _blobs(n=800, classes=2, sep=1.0, seed=3)
+    Xtr, ytr, Xte, yte = _split(X, y)
+    ours = get_trainer("dt")(runtime, Xtr, ytr, 2)
+    sk = DecisionTreeClassifier(max_depth=5).fit(Xtr, ytr)
+    assert _acc(runtime, ours, Xte, yte) >= \
+        float((sk.predict(Xte) == yte).mean()) - 0.05
+
+
+def test_rf_matches_sklearn(runtime):
+    from sklearn.ensemble import RandomForestClassifier
+
+    X, y = _blobs(n=800, classes=2, sep=1.0, seed=5)
+    Xtr, ytr, Xte, yte = _split(X, y)
+    ours = get_trainer("rf")(runtime, Xtr, ytr, 2)
+    sk = RandomForestClassifier(n_estimators=20, max_depth=5,
+                                random_state=0).fit(Xtr, ytr)
+    assert _acc(runtime, ours, Xte, yte) >= \
+        float((sk.predict(Xte) == yte).mean()) - 0.05
+
+
+def test_metrics_weighted_f1_matches_sklearn():
+    from sklearn.metrics import accuracy_score, f1_score
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, 200)
+    p = rng.integers(0, 3, 200)
+    m = classification_metrics(y, p, 3)
+    assert m["accuracy"] == pytest.approx(accuracy_score(y, p))
+    assert m["f1"] == pytest.approx(
+        f1_score(y, p, average="weighted"), abs=1e-6)
+
+
+def test_probabilities_sum_to_one(runtime):
+    X, y = _blobs(n=300, classes=2)
+    for kind in ("lr", "nb", "gb", "rf"):
+        model = get_trainer(kind)(runtime, X, y, 2)
+        probs = model.predict_proba(runtime, X[:50])
+        assert probs.shape == (50, 2)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-3)

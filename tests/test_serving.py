@@ -1,0 +1,181 @@
+"""End-to-end REST tests: the full Titanic-style pipeline through a live
+in-process server using the client SDK — the rebuild's analogue of the
+reference docs' Titanic walkthrough (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.client import (
+    Context, DatabaseApi, DataTypeHandler, Histogram, JobFailed, Model,
+    Pca, Projection, Tsne)
+from learningorchestra_tpu.serving.app import App
+
+CSV = """Pclass,Sex,Age,Fare,Survived
+3,male,22,7.25,0
+1,female,38,71.28,1
+3,female,26,7.92,1
+1,female,35,53.1,1
+3,male,35,8.05,0
+2,male,54,51.86,0
+3,male,2,21.07,0
+3,female,27,11.13,1
+2,female,14,30.07,1
+1,male,40,27.72,0
+"""
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    from learningorchestra_tpu.config import Settings
+
+    tmp = tmp_path_factory.mktemp("serve")
+    cfg = Settings()
+    cfg.store_root = str(tmp / "store")
+    cfg.image_root = str(tmp / "images")
+    cfg.port = 0  # ephemeral
+    cfg.persist = True
+    app = App(cfg, recover=False)
+    server = app.serve(background=True)
+    ctx = Context(f"http://127.0.0.1:{server.port}", poll_seconds=0.1,
+                  timeout=120)
+    # seed CSVs on disk for file:// ingestion (no egress in tests)
+    big_csv = tmp / "titanic.csv"
+    rows = [CSV.strip().split("\n")[0]]
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        pclass = rng.integers(1, 4)
+        sex = rng.choice(["male", "female"])
+        age = rng.integers(1, 70)
+        fare = round(float(rng.lognormal(2.5, 1.0)), 2)
+        surv = int(rng.random() < (0.7 if sex == "female" else 0.2))
+        rows.append(f"{pclass},{sex},{age},{fare},{surv}")
+    big_csv.write_text("\n".join(rows) + "\n")
+    yield ctx, app, str(big_csv)
+    server.stop()
+
+
+def test_full_pipeline(served):
+    ctx, app, csv_path = served
+    db = DatabaseApi(ctx)
+
+    # 1. ingest train + test
+    db.create_file("titanic_train", csv_path, wait=True)
+    db.create_file("titanic_test", csv_path, wait=True)
+    docs = db.read_file("titanic_train", limit=3)
+    assert docs[0]["_id"] == 0 and docs[0]["finished"] is True
+    assert docs[1]["Sex"] in ("male", "female")
+    assert len(db.read_files_descriptor()) >= 2
+
+    # 2. projection
+    Projection(ctx).create_projection(
+        "titanic_train", "titanic_proj", ["Sex", "Survived"])
+    meta = db.read_file("titanic_proj", limit=1)[0]
+    assert meta["fields"] == ["Sex", "Survived"]
+    assert meta["parent_filename"] == "titanic_train"
+
+    # 3. histogram
+    Histogram(ctx).create_histogram(
+        "titanic_train", "titanic_hist", ["Survived"])
+    docs = db.read_file("titanic_hist", limit=5)
+    counts = docs[1]["counts"]
+    assert set(counts) == {"0", "1"} or set(counts) == {0, 1}
+
+    # 4. type coercion
+    DataTypeHandler(ctx).change_file_type("titanic_proj",
+                                          {"Survived": "string"})
+    row = db.read_file("titanic_proj", skip=1, limit=1)[0]
+    assert isinstance(row["Survived"], str)
+
+    # 5. model builder, 5 classifiers (sync like the reference)
+    out = Model(ctx).create_model(
+        "titanic_train", "titanic_test", "pred",
+        ["lr", "dt", "rf", "gb", "nb"], "Survived")
+    results = {r["classifier"]: r for r in out["result"]}
+    assert set(results) == {"lr", "dt", "rf", "gb", "nb"}
+    for r in results.values():
+        assert r["fit_time"] > 0
+        assert r["accuracy"] > 0.5
+    meta = db.read_file("pred_lr", limit=1)[0]
+    assert meta["finished"] is True and meta["accuracy"] > 0.5
+    row = db.read_file("pred_lr", skip=1, limit=1)[0]
+    assert row["prediction"] in (0, 1) and len(row["probability"]) == 2
+
+    # 6. visualization (pca + tsne) and image CRUD
+    pca = Pca(ctx)
+    pca.create_image_plot("p1", "titanic_train", label_name="Survived")
+    assert "p1" in pca.read_image_plots()
+    png = pca.read_image_plot("p1")
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    tsne = Tsne(ctx)
+    tsne.create_image_plot("t1", "titanic_train", label_name="Survived",
+                           iters=60)
+    assert tsne.read_image_plot("t1")[:4] == b"\x89PNG"
+    tsne.delete_image_plot("t1")
+    assert "t1" not in tsne.read_image_plots()
+
+
+def test_error_paths(served):
+    ctx, app, csv_path = served
+    db = DatabaseApi(ctx)
+
+    # duplicate filename → 409 (reference server.py:44-48)
+    db.create_file("dup1", csv_path, wait=True)
+    with pytest.raises(RuntimeError, match="409"):
+        db.create_file("dup1", csv_path)
+
+    # missing dataset → 404
+    with pytest.raises(RuntimeError, match="404"):
+        db.read_file("missing_ds")
+
+    # bad projection fields → 406
+    with pytest.raises(RuntimeError, match="406"):
+        Projection(ctx).create_projection("dup1", "dup1p", ["NotAField"])
+
+    # unknown classifier → 406
+    with pytest.raises(RuntimeError, match="406"):
+        Model(ctx).create_model("dup1", "dup1", "px", ["svm"], "Survived")
+
+    # failed ingest: finished flips with error; waiter raises JobFailed
+    db.create_file("badfile", "/does/not/exist.csv")
+    with pytest.raises(JobFailed):
+        db.waiter.wait("badfile")
+
+    # exec preprocessing gated → 403
+    with pytest.raises(RuntimeError, match="403"):
+        Model(ctx).create_model("dup1", "dup1", "pexec", ["nb"], "Survived",
+                                preprocessor_code="x = 1")
+
+
+def test_cluster_and_jobs_routes(served):
+    ctx, app, _ = served
+    import requests
+
+    info = requests.get(ctx.url("/cluster")).json()
+    assert info["mesh"]["data"] == 8
+    assert info["platform"] == "cpu"
+    jobs = requests.get(ctx.url("/jobs")).json()
+    assert any(j["kind"] == "ingest" for j in jobs)
+
+
+def test_async_model_build(served):
+    ctx, app, csv_path = served
+    db = DatabaseApi(ctx)
+    db.create_file("amb_train", csv_path, wait=True)
+    out = Model(ctx).create_model(
+        "amb_train", "amb_train", "amb_pred", ["nb"], "Survived",
+        sync=False)
+    assert "amb_pred_nb" in out["prediction_datasets"]
+    meta = db.waiter.wait("amb_pred_nb")
+    assert meta["accuracy"] > 0.5
+
+
+def test_persistence_recovery(served, tmp_path):
+    """Server restart recovers the catalog from disk (upgrade over the
+    reference, whose durability lived in Mongo volumes)."""
+    ctx, app, _ = served
+    from learningorchestra_tpu.catalog.store import DatasetStore
+
+    store2 = DatasetStore(app.cfg)
+    loaded = store2.load_all()
+    assert "titanic_train" in loaded
+    assert store2.get("titanic_train").metadata.finished is True

@@ -1,0 +1,108 @@
+"""Visualization tests: PCA numerics vs sklearn, t-SNE cluster separation,
+image service CRUD."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.viz.pca import pca_embed
+from learningorchestra_tpu.viz.service import (
+    ImageExists, ImageNotFound, ImageService, create_embedding_image)
+from learningorchestra_tpu.viz.tsne import tsne_embed
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    return MeshRuntime(Settings())
+
+
+def _clusters(n_per=60, d=10, classes=3, sep=8.0, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * sep
+    X = np.concatenate([centers[c] + rng.normal(size=(n_per, d))
+                        for c in range(classes)])
+    y = np.repeat(np.arange(classes), n_per)
+    return X.astype(np.float32), y
+
+
+def test_pca_matches_sklearn(runtime):
+    from sklearn.decomposition import PCA
+
+    X, _ = _clusters()
+    ours = pca_embed(runtime, X)
+    sk = PCA(n_components=2).fit_transform(X)
+    # Components are defined up to sign; compare absolute correlation.
+    for j in range(2):
+        r = np.corrcoef(ours[:, j], sk[:, j])[0, 1]
+        assert abs(r) > 0.99
+
+
+def test_pca_odd_row_count(runtime):
+    X = np.random.default_rng(0).normal(size=(101, 5)).astype(np.float32)
+    emb = pca_embed(runtime, X)
+    assert emb.shape == (101, 2)
+    assert np.isfinite(emb).all()
+
+
+def _silhouette_like(emb, y):
+    """Mean inter-centroid distance / mean intra-cluster spread."""
+    cents = np.stack([emb[y == c].mean(axis=0) for c in np.unique(y)])
+    intra = np.mean([np.linalg.norm(emb[y == c] - cents[i], axis=1).mean()
+                     for i, c in enumerate(np.unique(y))])
+    inter = np.mean([np.linalg.norm(cents[i] - cents[j])
+                     for i in range(len(cents))
+                     for j in range(i + 1, len(cents))])
+    return inter / max(intra, 1e-9)
+
+
+def test_tsne_separates_clusters(runtime):
+    X, y = _clusters(n_per=50, sep=12.0)
+    emb = tsne_embed(runtime, X, perplexity=15, iters=300,
+                     exaggeration_iters=100)
+    assert emb.shape == (150, 2)
+    assert np.isfinite(emb).all()
+    assert _silhouette_like(emb, y) > 2.0
+
+
+def test_create_embedding_images(store, runtime, cfg):
+    X, y = _clusters(n_per=30)
+    store.create("viz_src", columns={
+        **{f"f{i}": X[:, i] for i in range(X.shape[1])},
+        "label": y.astype(np.int64)}, finished=True)
+    for method in ("pca", "tsne"):
+        path = create_embedding_image(
+            store, runtime, method, "viz_src", "img1", label="label",
+            image_root=cfg.image_root,
+            **({"iters": 50, "exaggeration_iters": 20}
+               if method == "tsne" else {}))
+        assert path.endswith(f"{method}/img1.png")
+        import os
+        assert os.path.getsize(path) > 1000
+
+
+def test_image_service_crud(cfg, tmp_path):
+    svc = ImageService("tsne", cfg)
+    assert svc.list_names() == []
+    with pytest.raises(ImageNotFound):
+        svc.get_path("nope")
+    import os
+    p = os.path.join(cfg.image_root, "tsne", "a.png")
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    with open(p, "wb") as f:
+        f.write(b"png")
+    assert svc.list_names() == ["a"]
+    with pytest.raises(ImageExists):
+        svc.validate_new("a")
+    svc.delete("a")
+    assert svc.list_names() == []
+
+
+def test_embedding_label_validation(store, runtime, cfg):
+    store.create("v2", columns={"x": np.arange(10.0)}, finished=True)
+    with pytest.raises(ValueError, match="label field"):
+        create_embedding_image(store, runtime, "pca", "v2", "i",
+                               label="nope", image_root=cfg.image_root)
+    with pytest.raises(ValueError, match="unknown embedding"):
+        create_embedding_image(store, runtime, "umap", "v2", "i",
+                               image_root=cfg.image_root)
