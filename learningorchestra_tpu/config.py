@@ -55,6 +55,14 @@ class Settings:
         default_factory=lambda: _env("LO_TPU_USE_NATIVE_CSV", True, bool)
     )
 
+    # --- kernels -----------------------------------------------------------
+    #: Use hand-written Pallas kernels for hot inner loops (t-SNE repulsion;
+    #: ops/pallas_kernels.py). Off-TPU they run in interpreter mode, so the
+    #: flag is safe everywhere; disable to force the pure-XLA fallbacks.
+    use_pallas: bool = field(
+        default_factory=lambda: _env("LO_TPU_USE_PALLAS", True, bool)
+    )
+
     # --- mesh / parallelism ------------------------------------------------
     #: Mesh axis names. "data" shards rows (the reference's Spark partitioning
     #: axis, SURVEY.md §2 parallelism #1); "model" shards features/params.

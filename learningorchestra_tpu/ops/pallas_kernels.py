@@ -1,0 +1,129 @@
+"""Pallas TPU kernels for the framework's hottest inner loops.
+
+The reference's native horsepower lived in the external Spark JVM
+(SURVEY.md §2); here the native tier is hand-written TPU kernels for the
+ops XLA alone schedules sub-optimally. First resident: the t-SNE exact
+repulsion — the O(n²) loop executed every one of ~750 descent iterations
+(viz/tsne.py), dominating embed wall-clock at MNIST-60k scale.
+
+Why a kernel instead of the pure-XLA `lax.scan` tiling: the scan
+materializes each (tile × n) distance block in HBM-visible intermediates
+between ops. The Pallas version keeps the whole block pipeline — distance,
+Student-t weight, masking, the three reductions — in VMEM registers per
+(row-tile × col-tile) grid cell, with zero HBM traffic beyond streaming the
+(n, 1) coordinate vectors and accumulating (n, 1) force outputs. All
+arithmetic is VPU-shaped: (TILE_R, TILE_C) elementwise blocks, no matmuls
+(the 2-D embedding makes the MXU useless here — inner dimension 2).
+
+On non-TPU backends every `pallas_call` runs in interpreter mode, so the
+same code path is unit-tested on the CPU mesh (tests/conftest.py) and
+cross-checked against the pure-XLA reference implementation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: Row/col tile for the repulsion grid. 512×512 f32 blocks are 1 MB —
+#: a handful fit VMEM alongside the coordinate vectors; big enough that
+#: the (8, 128) f32 sublane×lane tiling is fully utilized.
+TILE = 512
+
+
+def _interpret() -> bool:
+    """Interpreter mode off-TPU so kernels run (and are tested) anywhere."""
+    return jax.default_backend() != "tpu"
+
+
+def _repulsion_kernel(xr_ref, yr_ref, vr_ref, xc_ref, yc_ref, vc_ref,
+                      z_ref, fx_ref, fy_ref):
+    """One (row-tile i, col-tile j) cell of the pairwise Student-t grid.
+
+    Refs: xr/yr/vr are (TILE, 1) row-block coordinate/valid columns;
+    xc/yc/vc are (1, TILE) col-block rows. Outputs: fx/fy accumulate the
+    repulsive force numerator per row block (revisited across j, so the
+    block stays resident in VMEM while the column tiles stream past);
+    z is the (1, 1) SMEM running sum of all q_ij (the normalizer Z).
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    tile = xr_ref.shape[0]
+
+    dx = xr_ref[:] - xc_ref[:]                      # (tile, tile)
+    dy = yr_ref[:] - yc_ref[:]
+    q = 1.0 / (1.0 + dx * dx + dy * dy)
+
+    # Mask invalid (padding) rows/cols and the self-pair diagonal.
+    rid = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 0)
+    cid = j * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
+    q = q * (vr_ref[:] * vc_ref[:]) * (rid != cid).astype(jnp.float32)
+
+    q2 = q * q
+    s = jnp.sum(q2, axis=1, keepdims=True)          # (TILE, 1)
+    fx = xr_ref[:] * s - jnp.sum(q2 * xc_ref[:], axis=1, keepdims=True)
+    fy = yr_ref[:] * s - jnp.sum(q2 * yc_ref[:], axis=1, keepdims=True)
+    zp = jnp.sum(q)
+
+    @pl.when(j == 0)
+    def _init_row():
+        fx_ref[:] = fx
+        fy_ref[:] = fy
+
+    @pl.when(j != 0)
+    def _acc_row():
+        fx_ref[:] += fx
+        fy_ref[:] += fy
+
+    @pl.when((i == 0) & (j == 0))
+    def _init_z():
+        z_ref[0, 0] = zp
+
+    @pl.when((i != 0) | (j != 0))
+    def _acc_z():
+        z_ref[0, 0] += zp
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def tsne_repulsion(Y: jax.Array, valid: jax.Array, *, tile: int = TILE):
+    """Exact t-SNE repulsion over all pairs of a 2-D embedding.
+
+    Y: (n, 2) float32, n a multiple of ``tile`` (padding masked by
+    ``valid``). Returns (Z, F): the scalar partition-function sum
+    Σ_{i≠j} q_ij and the (n, 2) force numerator Σ_j q²_ij (y_i − y_j) —
+    identical semantics to the pure-XLA ``rep_block`` scan in viz/tsne.py.
+    """
+    n = Y.shape[0]
+    assert n % tile == 0, (n, tile)
+    nb = n // tile
+    xr = Y[:, 0:1]
+    yr = Y[:, 1:2]
+    vr = valid[:, None]
+    xc = Y[:, 0][None, :]
+    yc = Y[:, 1][None, :]
+    vc = valid[None, :]
+
+    grid = (nb, nb)
+    row_spec = pl.BlockSpec((tile, 1), lambda i, j: (i, 0))
+    col_spec = pl.BlockSpec((1, tile), lambda i, j: (0, j))
+    out_row_spec = pl.BlockSpec((tile, 1), lambda i, j: (i, 0))
+    z_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    z, fx, fy = pl.pallas_call(
+        _repulsion_kernel,
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec,
+                  col_spec, col_spec, col_spec],
+        out_specs=[z_spec, out_row_spec, out_row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xr, yr, vr, xc, yc, vc)
+    return z[0, 0], jnp.concatenate([fx, fy], axis=1)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from learningorchestra_tpu.ops import pallas_kernels
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
 from learningorchestra_tpu.viz.pca import pca_embed
 
@@ -100,35 +101,43 @@ def _calibrate(d2k, perplexity):
     return P                                       # (n, k) row-normalized
 
 
-@partial(jax.jit, static_argnames=("tile",), donate_argnums=(0,))
+@partial(jax.jit, static_argnames=("tile", "use_pallas"), donate_argnums=(0,))
 def _step(Y, vel, gains, P, idx, n_valid, exaggeration, eta, momentum, *,
-          tile):
+          tile, use_pallas=False):
     n = Y.shape[0]
     valid = (jnp.arange(n) < n_valid).astype(jnp.float32)
 
     # --- exact repulsion: tiled full-pairwise over the 2-D embedding -------
-    ysq = (Y * Y).sum(axis=1)
+    if use_pallas:
+        # Fused Pallas kernel: whole block pipeline stays in VMEM
+        # (ops/pallas_kernels.py); semantics identical to the scan below.
+        # The kernel's grid tile is capped at its VMEM-sized TILE — n is
+        # padded to a multiple of the (>=) scan tile, so divisibility holds.
+        Z, Frep = pallas_kernels.tsne_repulsion(
+            Y, valid, tile=min(tile, pallas_kernels.TILE))
+    else:
+        ysq = (Y * Y).sum(axis=1)
 
-    def rep_block(carry, i):
-        Z_acc, F = carry
-        rows = jax.lax.dynamic_slice_in_dim(Y, i * tile, tile)
-        rsq = jax.lax.dynamic_slice_in_dim(ysq, i * tile, tile)
-        d2 = rsq[:, None] + ysq[None, :] - 2.0 * (rows @ Y.T)
-        q = 1.0 / (1.0 + d2)
-        row_ids = i * tile + jnp.arange(tile)
-        pair_valid = (valid[None, :] * valid[row_ids][:, None]
-                      * (jnp.arange(n)[None, :] != row_ids[:, None]))
-        q = q * pair_valid
-        Z_acc = Z_acc + q.sum()
-        # repulsive force numerator: sum_j q² (yi − yj)
-        q2 = q * q
-        f = rows * q2.sum(axis=1, keepdims=True) - q2 @ Y
-        F = jax.lax.dynamic_update_slice_in_dim(F, f, i * tile, axis=0)
-        return (Z_acc, F), None
+        def rep_block(carry, i):
+            Z_acc, F = carry
+            rows = jax.lax.dynamic_slice_in_dim(Y, i * tile, tile)
+            rsq = jax.lax.dynamic_slice_in_dim(ysq, i * tile, tile)
+            d2 = rsq[:, None] + ysq[None, :] - 2.0 * (rows @ Y.T)
+            q = 1.0 / (1.0 + d2)
+            row_ids = i * tile + jnp.arange(tile)
+            pair_valid = (valid[None, :] * valid[row_ids][:, None]
+                          * (jnp.arange(n)[None, :] != row_ids[:, None]))
+            q = q * pair_valid
+            Z_acc = Z_acc + q.sum()
+            # repulsive force numerator: sum_j q² (yi − yj)
+            q2 = q * q
+            f = rows * q2.sum(axis=1, keepdims=True) - q2 @ Y
+            F = jax.lax.dynamic_update_slice_in_dim(F, f, i * tile, axis=0)
+            return (Z_acc, F), None
 
-    (Z, Frep), _ = jax.lax.scan(
-        rep_block, (jnp.float32(0.0), jnp.zeros_like(Y)),
-        jnp.arange(n // tile))
+        (Z, Frep), _ = jax.lax.scan(
+            rep_block, (jnp.float32(0.0), jnp.zeros_like(Y)),
+            jnp.arange(n // tile))
     Z = jnp.maximum(Z, 1e-12)
 
     # --- sparse symmetric attraction over kNN edges ------------------------
@@ -182,11 +191,15 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
     if eta is None:
         eta = max(float(n_valid) / 12.0 / 4.0, 50.0)  # learning rate n/48
     nv = jnp.float32(n_valid)
+    # The fused kernel wants lane-width (≥128) tiles; tiny datasets use the
+    # XLA scan path, which is compile-time-cheaper there anyway.
+    use_pallas = bool(runtime.cfg.use_pallas) and tile >= 128
 
     for it in range(iters):
         exag = 12.0 if it < exaggeration_iters else 1.0
         momentum = 0.5 if it < exaggeration_iters else 0.8
         Y, vel, gains = _step(Y, vel, gains, P, idx, nv,
                               jnp.float32(exag), jnp.float32(eta),
-                              jnp.float32(momentum), tile=tile)
+                              jnp.float32(momentum), tile=tile,
+                              use_pallas=use_pallas)
     return np.asarray(Y)[:n_valid]
