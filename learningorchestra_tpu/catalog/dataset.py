@@ -222,13 +222,18 @@ def _concat(arrays: List[np.ndarray]) -> np.ndarray:
     keep one consistent value domain for queries and value_counts."""
     has_obj = any(a.dtype == object for a in arrays)
     if has_obj and any(a.dtype != object for a in arrays):
-        arrays = [_stringify(a) if a.dtype != object else a for a in arrays]
+        arrays = [stringify_numeric(a) if a.dtype != object else a
+                  for a in arrays]
     elif has_obj:
         arrays = [a.astype(object) for a in arrays]
     return np.concatenate(arrays)
 
 
-def _stringify(a: np.ndarray) -> np.ndarray:
+def stringify_numeric(a: np.ndarray) -> np.ndarray:
+    """Numeric column → object strings: NaN → None, integral floats print
+    as ints. The single number→string value-domain rule, shared with the
+    fieldtypes coercion op (ops/dtypes.py; reference
+    data_type_handler.py:63-70)."""
     out = np.empty(len(a), dtype=object)
     is_float = a.dtype.kind == "f"
     for i, v in enumerate(a):

@@ -55,6 +55,29 @@ def validate_name(name: str) -> str:
     return name
 
 
+def column_value_counts(col: np.ndarray) -> Dict[Any, int]:
+    """Value→count mapping for one column; missing values (None/NaN) bucket
+    under the None key (Mongo $group keeps null as a distinct group key).
+    Shared by ``DatasetStore.value_counts`` and the histogram op's host
+    fallback (ops/histogram.py)."""
+    if col.dtype == object:
+        null_mask = np.array([v is None for v in col], dtype=bool)
+        vals = col[~null_mask].astype(str)
+    else:
+        null_mask = (np.isnan(col) if col.dtype.kind == "f"
+                     else np.zeros(len(col), dtype=bool))
+        vals = col[~null_mask]
+    uniq, counts = np.unique(vals, return_counts=True)
+    out: Dict[Any, int] = {}
+    for u, c in zip(uniq, counts):
+        u = u.item() if isinstance(u, np.generic) else u
+        out[u] = int(c)
+    n_null = int(null_mask.sum())
+    if n_null:
+        out[None] = n_null
+    return out
+
+
 class DatasetStore:
     """In-memory catalog of named datasets with optional disk persistence."""
 
@@ -183,26 +206,7 @@ class DatasetStore:
         """Per-value counts of a column — the reference's histogram
         aggregation ``[{"$group": {"_id": "$field", "count": {"$sum": 1}}}]``
         (histogram.py:49-74), vectorized."""
-        ds = self.get(name)
-        col = ds.columns[field]
-        if col.dtype == object:
-            null_mask = np.array([v is None for v in col], dtype=bool)
-            vals = col[~null_mask].astype(str)
-        else:
-            null_mask = (np.isnan(col) if col.dtype.kind == "f"
-                         else np.zeros(len(col), dtype=bool))
-            vals = col[~null_mask]
-        uniq, counts = np.unique(vals, return_counts=True)
-        out: Dict[Any, int] = {}
-        for u, c in zip(uniq, counts):
-            u = u.item() if isinstance(u, np.generic) else u
-            out[u] = int(c)
-        n_null = int(null_mask.sum())
-        if n_null:
-            # Missing values bucket under the None key (Mongo $group keeps
-            # null as a distinct group key; JSON renders it as "null").
-            out[None] = n_null
-        return out
+        return column_value_counts(self.get(name).columns[field])
 
     # -- persistence ---------------------------------------------------------
 

@@ -63,14 +63,28 @@ class AsyncronousWait:
     def __init__(self, context: Context):
         self.context = context
 
-    def wait(self, dataset_name: str) -> Dict[str, Any]:
+    def wait(self, dataset_name: str,
+             tolerate_missing: bool = False) -> Dict[str, Any]:
+        """Poll until the dataset's metadata reports ``finished``.
+
+        ``tolerate_missing`` keeps polling through 404s until the deadline —
+        for datasets the server has *promised* to create (an async model
+        build creates its prediction datasets only after preprocessing), as
+        opposed to datasets that must already exist.
+        """
         deadline = time.time() + self.context.timeout
         while True:
             resp = requests.get(
                 self.context.url(f"/files/{dataset_name}"),
                 params={"limit": 1})
             if resp.status_code == 404:
-                raise KeyError(f"dataset not found: {dataset_name}")
+                if not tolerate_missing:
+                    raise KeyError(f"dataset not found: {dataset_name}")
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"timed out waiting for {dataset_name} to appear")
+                time.sleep(self.context.poll_seconds)
+                continue
             docs = ResponseTreat.treatment(resp)
             if docs:
                 meta = docs[0]
@@ -240,5 +254,6 @@ class Model(_ServiceClient):
             self.context.url("/models"), json=body))
         if not sync:
             for c in classificators_list:
-                self.waiter.wait(f"{prediction_filename}_{c}")
+                self.waiter.wait(f"{prediction_filename}_{c}",
+                                 tolerate_missing=True)
         return out

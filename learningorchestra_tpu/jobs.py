@@ -57,14 +57,22 @@ class JobManager:
         self._jobs: Dict[str, JobRecord] = {}
         self._seq = 0
 
-    def submit(self, kind: str, dataset: str,
+    def submit(self, kind: str, dataset,
                fn: Callable[[], Any]) -> JobRecord:
-        """Run ``fn`` async. On exception, mark the dataset failed in the
-        catalog (finished=True + error) so pollers terminate."""
+        """Run ``fn`` async. On exception, mark the job's dataset(s) failed
+        in the catalog (finished=True + error) so pollers terminate.
+
+        ``dataset`` may be one name or a sequence of names — a model build
+        owns one prediction dataset per classifier and all of them must
+        reach a terminal state if the job dies before (or after) creating
+        them.
+        """
+        datasets: List[str] = ([dataset] if isinstance(dataset, str)
+                               else list(dataset))
         with self._lock:
             self._seq += 1
-            rec = JobRecord(job_id=f"{kind}-{self._seq}", dataset=dataset,
-                            kind=kind)
+            rec = JobRecord(job_id=f"{kind}-{self._seq}",
+                            dataset=",".join(datasets), kind=kind)
             self._jobs[rec.job_id] = rec
             if len(self._jobs) > self.MAX_RECORDS:
                 for jid, r in list(self._jobs.items()):
@@ -81,10 +89,14 @@ class JobManager:
                 rec.status = "failed"
                 rec.error = f"{type(exc).__name__}: {exc}"
                 traceback.print_exc()
-                try:
-                    self.store.fail(dataset, rec.error)
-                except Exception:
-                    pass
+                for name in datasets:
+                    # Only unfinished datasets get the failure flag — ones
+                    # that completed before the crash keep their results.
+                    try:
+                        if not self.store.get(name).metadata.finished:
+                            self.store.fail(name, rec.error)
+                    except Exception:
+                        pass
             finally:
                 rec.finished_at = time.time()
 

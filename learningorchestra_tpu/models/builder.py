@@ -62,11 +62,14 @@ class ModelBuilder:
               steps: Sequence[Dict[str, Any]] = (),
               preprocessor_code: Optional[str] = None,
               hparams: Optional[Dict[str, Dict[str, Any]]] = None,
-              ) -> List[FitReport]:
+              existing: bool = False) -> List[FitReport]:
         """Fit all requested classifiers; returns per-classifier reports.
 
         Synchronous core (the reference's POST /models also blocks until all
         fits finish, SURVEY.md §3.2); the serving layer may wrap it in a job.
+        ``existing=True`` means the caller already created the prediction
+        datasets (the async route does, metadata-first, so pollers can see
+        them — and their failure flags — from the moment of submission).
         """
         train_ds = self.store.get(train)
         test_ds = self.store.get(test)
@@ -93,9 +96,10 @@ class ModelBuilder:
 
         # Create all output datasets first (metadata-first protocol), so
         # pollers see them immediately with finished=false.
-        for c in classifiers:
-            self.store.create(f"{prediction_name}_{c}", parent=test,
-                              extra={"classifier": c, "label": label})
+        if not existing:
+            for c in classifiers:
+                self.store.create(f"{prediction_name}_{c}", parent=test,
+                                  extra={"classifier": c, "label": label})
 
         def fit_one(c: str) -> FitReport:
             trainer = get_trainer(c)

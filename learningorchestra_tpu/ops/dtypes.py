@@ -14,6 +14,7 @@ from typing import Dict
 
 import numpy as np
 
+from learningorchestra_tpu.catalog.dataset import stringify_numeric
 from learningorchestra_tpu.catalog.store import DatasetStore
 
 VALID_TYPES = ("number", "string")
@@ -42,19 +43,9 @@ def _to_string(col: np.ndarray) -> np.ndarray:
     if col.dtype == object:
         return np.array([None if v is None else str(v) for v in col],
                         dtype=object)
-    out = np.empty(len(col), dtype=object)
-    is_float = col.dtype.kind == "f"
-    for i, v in enumerate(col):
-        if is_float and np.isnan(v):
-            out[i] = None
-        else:
-            # Integral floats print as ints, matching the reference's
-            # number→string round-trip (data_type_handler.py:63-70).
-            if is_float and v == int(v):
-                out[i] = str(int(v))
-            else:
-                out[i] = str(v)
-    return out
+    # Integral floats print as ints, NaN → None — the shared value-domain
+    # rule (reference data_type_handler.py:63-70).
+    return stringify_numeric(col)
 
 
 def convert_fields(store: DatasetStore, name: str,

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.catalog.store import (
+    DatasetStore, column_value_counts)
 from learningorchestra_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, MeshRuntime
 
 #: Columns with more distinct integer levels than this go to the host path —
@@ -72,19 +73,7 @@ def field_counts(runtime: MeshRuntime, col: np.ndarray) -> Dict:
                 num_bins=num_bins, mesh=runtime.mesh))
             return {int(lo + i): int(c) for i, c in enumerate(counts) if c}
     # host fallback: floats, strings, huge integer ranges
-    if col.dtype == object:
-        null = np.array([v is None for v in col], dtype=bool)
-        vals = col[~null].astype(str)
-    else:
-        null = np.isnan(col) if col.dtype.kind == "f" else np.zeros(
-            len(col), bool)
-        vals = col[~null]
-    uniq, counts = np.unique(vals, return_counts=True)
-    out = {u.item() if isinstance(u, np.generic) else u: int(c)
-           for u, c in zip(uniq, counts)}
-    if null.any():
-        out[None] = int(null.sum())
-    return out
+    return column_value_counts(col)
 
 
 def create_histogram(store: DatasetStore, runtime: MeshRuntime,

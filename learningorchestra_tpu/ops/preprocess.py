@@ -76,15 +76,18 @@ def apply_steps(columns: Dict[str, np.ndarray],
             state[key] = vocabs
         elif op == "fillna":
             strategy = step.get("strategy", "mean")
+            fitted = key in state      # applying train-fitted stats to test
             fill = state.get(key, {})
             for f, c in cols.items():
                 if c.dtype.kind != "f":
                     continue
-                if f not in fill:
-                    if not np.isnan(c).any():
-                        continue
+                if not fitted and f not in fill:
+                    # Fit the statistic for EVERY float column (even ones
+                    # with no NaN here) so the test pass never computes its
+                    # own — fit-on-train, apply-to-test.
                     if strategy == "mean":
-                        fill[f] = float(np.nanmean(c))
+                        fill[f] = (0.0 if np.isnan(c).all()
+                                   else float(np.nanmean(c)))
                     elif strategy == "zero":
                         fill[f] = 0.0
                     elif strategy == "value":
@@ -92,7 +95,8 @@ def apply_steps(columns: Dict[str, np.ndarray],
                     else:
                         raise PreprocessError(
                             f"unknown fillna strategy {strategy!r}")
-                cols[f] = np.where(np.isnan(c), fill[f], c)
+                if f in fill and np.isnan(c).any():
+                    cols[f] = np.where(np.isnan(c), fill[f], c)
             state[key] = fill
         elif op == "cast":
             dtype = step.get("dtype", "float32")

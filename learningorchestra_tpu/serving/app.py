@@ -179,16 +179,22 @@ class App:
                     {"classifier": r.kind, "fit_time": r.fit_time,
                      **r.metrics} for r in reports]}
 
+            # Create every prediction dataset up front (metadata-first), so
+            # a failure at ANY point of the async build is pollable on all
+            # of them — never the reference's finished:false-forever state.
+            pred_datasets = [f"{pred_name}_{c}" for c in classifiers]
+            for c in classifiers:
+                app.store.create(f"{pred_name}_{c}", parent=test,
+                                 extra={"classifier": c, "label": label})
+
             def run():
                 app.builder.build(train, test, pred_name, classifiers, label,
                                   steps=steps, preprocessor_code=code,
-                                  hparams=hparams)
+                                  hparams=hparams, existing=True)
 
-            app.jobs.submit("model_builder", f"{pred_name}_{classifiers[0]}",
-                            run)
+            app.jobs.submit("model_builder", pred_datasets, run)
             return 201, {"result": "model build started",
-                         "prediction_datasets": [
-                             f"{pred_name}_{c}" for c in classifiers]}
+                         "prediction_datasets": pred_datasets}
 
         # ---- tsne / pca images (reference tsne_image/server.py:57-155)
         for method in ("tsne", "pca"):
@@ -225,6 +231,14 @@ class App:
                     parent).metadata.fields:
                 raise ValueError(f"label field not in dataset: {label}")
             marker = f"img.{method}.{name}"
+            # A finished marker whose PNG is gone (deleted, or the job
+            # failed) is stale — clear it so the name is reusable. An
+            # unfinished marker means a build is in flight: 409.
+            if app.store.exists(marker):
+                if not app.store.get(marker).metadata.finished:
+                    raise DatasetExists(
+                        f"{method} image {name} build in progress")
+                app.store.delete(marker)
             app.store.create(marker, parent=parent)
             kwargs = {k: req.body[k] for k in
                       ("perplexity", "iters") if k in req.body}
@@ -249,8 +263,12 @@ class App:
             return 200, FileResponse(svc.get_path(req.params["name"]))
 
         @self._route("DELETE", f"/{method}/images/{{name}}")
-        def delete_image(req, svc=svc):
+        def delete_image(req, method=method, svc=svc):
             svc.delete(req.params["name"])
+            # Drop the poll-marker dataset too, so the name can be reused.
+            marker = f"img.{method}.{req.params['name']}"
+            if app.store.exists(marker):
+                app.store.delete(marker)
             return 200, {"result": "deleted"}
 
     # -- lifecycle -----------------------------------------------------------

@@ -140,3 +140,14 @@ labels_testing = testing_df["Survived"].to_numpy()
     reports = mb.build("train", "test", "pe", ["nb"], "Survived",
                        preprocessor_code=code)
     assert reports[0].metrics["accuracy"] > 0.4
+
+
+def test_fillna_fits_on_train_only():
+    """The fill statistic comes from the fitting pass even when the fitted
+    column had no NaN — test-set NaNs must use the TRAIN mean."""
+    train = {"a": np.array([1.0, 2.0, 3.0])}          # no NaN at fit time
+    test = {"a": np.array([np.nan, 10.0, np.nan])}
+    steps = [{"op": "fillna", "strategy": "mean"}]
+    _, state = apply_steps(train, steps)
+    out, _ = apply_steps(test, steps, state=state)
+    np.testing.assert_allclose(out["a"], [2.0, 10.0, 2.0])

@@ -179,3 +179,41 @@ def test_persistence_recovery(served, tmp_path):
     loaded = store2.load_all()
     assert "titanic_train" in loaded
     assert store2.get("titanic_train").metadata.finished is True
+
+
+def test_image_delete_then_recreate(served):
+    """Deleting an image must free its name entirely (PNG + poll marker) —
+    re-creating under the same name used to 409 forever."""
+    ctx, app, csv_path = served
+    db = DatabaseApi(ctx)
+    db.create_file("imgcycle", csv_path, wait=True)
+    pca = Pca(ctx)
+    pca.create_image_plot("cyc", "imgcycle", label_name="Survived")
+    pca.delete_image_plot("cyc")
+    assert "cyc" not in pca.read_image_plots()
+    # same name again: must succeed, not 409
+    pca.create_image_plot("cyc", "imgcycle", label_name="Survived")
+    assert pca.read_image_plot("cyc")[:4] == b"\x89PNG"
+    pca.delete_image_plot("cyc")
+
+
+def test_async_build_failure_is_pollable(served):
+    """A build that dies before fitting (bad label) must still flip every
+    promised prediction dataset to finished+error — pollers terminate."""
+    ctx, app, csv_path = served
+    db = DatabaseApi(ctx)
+    db.create_file("abf_train", csv_path, wait=True)
+    out = Model(ctx).__class__  # use raw requests to skip client-side waits
+    import requests
+
+    resp = requests.post(ctx.url("/models"), json={
+        "training_filename": "abf_train", "test_filename": "abf_train",
+        "prediction_filename": "abf_pred",
+        "classificators_list": ["nb", "lr"],
+        "label": "NoSuchColumn", "sync": False})
+    assert resp.status_code == 201
+    for name in ("abf_pred_nb", "abf_pred_lr"):
+        with pytest.raises(JobFailed):
+            db.waiter.wait(name, tolerate_missing=True)
+        meta = db.read_file(name, limit=1)[0]
+        assert meta["finished"] is True and meta["error"]
