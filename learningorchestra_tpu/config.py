@@ -68,7 +68,8 @@ class Settings:
     #: axis, SURVEY.md §2 parallelism #1); "model" shards features/params.
     data_axis: str = "data"
     model_axis: str = "model"
-    #: Optional forced mesh shape "D,M"; empty = use all local devices on data.
+    #: Optional forced mesh shape "D,M" or "D,M,S" (data × model × seq);
+    #: empty = all local devices on the data axis.
     mesh_shape: str = field(default_factory=lambda: _env("LO_TPU_MESH_SHAPE", ""))
 
     # --- serving -----------------------------------------------------------

@@ -1,0 +1,201 @@
+"""Sequence transformer — the long-context model family (dp × tp × sp).
+
+No reference behavior exists to match (the reference predates sequence
+models, SURVEY.md §5); this family exists because long-context and
+distributed execution are first-class in the rebuild. The training step is
+one SPMD program over the full 3-axis mesh (parallel/mesh.py):
+
+- ``data``  — batch rows sharded (the reference's only parallelism axis);
+- ``model`` — Megatron-style tensor parallelism: attention heads and the
+  FFN hidden dimension are column-split, output projections row-split with
+  one ``psum`` per block over ICI;
+- ``seq``   — context parallelism: sequence length is sharded and exact
+  attention runs as a ring of ``ppermute`` hops
+  (parallel/ring_attention.py), so max context scales linearly with the
+  seq-axis size.
+
+Differentiation goes *through* ``shard_map`` (check_vma replication
+tracking makes the psum/ppermute transposes produce correctly-reduced
+gradients for replicated and sharded parameters alike), so the optimizer
+update is ordinary optax on sharded pytrees.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from learningorchestra_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+from learningorchestra_tpu.parallel.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class TxConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 256
+    n_classes: int = 2
+    max_len: int = 1024
+    causal: bool = False          # classifier default; True for LM-style
+
+
+def init_params(key, cfg: TxConfig) -> Dict[str, Any]:
+    hd = cfg.d_model // cfg.n_heads
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layers))
+
+    def dense(k, *shape, scale=None):
+        scale = scale or 1.0 / np.sqrt(shape[0])
+        return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    params: Dict[str, Any] = {
+        "embed": dense(next(keys), cfg.vocab, cfg.d_model, scale=0.02),
+        "pos": dense(next(keys), cfg.max_len, cfg.d_model, scale=0.02),
+        "head_w": dense(next(keys), cfg.d_model, cfg.n_classes),
+        "head_b": jnp.zeros(cfg.n_classes),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "ln1_g": jnp.ones(cfg.d_model), "ln1_b": jnp.zeros(cfg.d_model),
+            "wqkv": dense(next(keys), cfg.d_model, 3, cfg.n_heads, hd),
+            "wo": dense(next(keys), cfg.n_heads, hd, cfg.d_model,
+                        scale=1.0 / np.sqrt(cfg.d_model)),
+            "ln2_g": jnp.ones(cfg.d_model), "ln2_b": jnp.zeros(cfg.d_model),
+            "w1": dense(next(keys), cfg.d_model, cfg.d_ff),
+            "b1": jnp.zeros(cfg.d_ff),
+            "w2": dense(next(keys), cfg.d_ff, cfg.d_model),
+            "b2": jnp.zeros(cfg.d_model),
+        })
+    return params
+
+
+def param_specs(cfg: TxConfig) -> Dict[str, Any]:
+    """PartitionSpec per leaf: heads / FFN hidden on the model axis, the
+    rest replicated (small embeddings; sharding them buys nothing here)."""
+    layer = {
+        "ln1_g": P(), "ln1_b": P(),
+        "wqkv": P(None, None, MODEL_AXIS, None),
+        "wo": P(MODEL_AXIS, None, None),
+        "ln2_g": P(), "ln2_b": P(),
+        "w1": P(None, MODEL_AXIS), "b1": P(MODEL_AXIS),
+        "w2": P(MODEL_AXIS, None), "b2": P(),
+    }
+    return {"embed": P(), "pos": P(), "head_w": P(), "head_b": P(),
+            "layers": [dict(layer) for _ in range(cfg.n_layers)]}
+
+
+def _ln(x, g, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def forward_shard(params, tokens, *, cfg: TxConfig):
+    """Per-shard forward (runs inside shard_map over the 3-axis mesh).
+
+    tokens: (B_local, T_local) int32 → logits (B_local, n_classes),
+    replicated over model and seq axes.
+    """
+    seq_idx = jax.lax.axis_index(SEQ_AXIS)
+    seq_size = jax.lax.psum(1, SEQ_AXIS)
+    Tl = tokens.shape[1]
+    if Tl * seq_size > cfg.max_len:
+        # Caught at trace time (both values static): an out-of-range
+        # position gather would silently clamp to the last row under jit.
+        raise ValueError(
+            f"sequence length {Tl * seq_size} exceeds max_len "
+            f"{cfg.max_len}")
+    pos = seq_idx * Tl + jnp.arange(Tl)
+    x = params["embed"][tokens] + params["pos"][pos][None, :, :]
+
+    for lyr in params["layers"]:
+        # --- attention: heads column-split (tp), ring over seq (sp) -------
+        h = _ln(x, lyr["ln1_g"], lyr["ln1_b"])
+        qkv = jnp.einsum("btd,dkhe->btkhe", h, lyr["wqkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = ring_attention(q, k, v, axis_name=SEQ_AXIS,
+                              causal=cfg.causal)
+        out = jnp.einsum("bthe,hed->btd", attn, lyr["wo"])
+        x = x + jax.lax.psum(out, MODEL_AXIS)      # row-parallel reduce
+        # --- FFN: hidden dim column-split (tp) ----------------------------
+        h = _ln(x, lyr["ln2_g"], lyr["ln2_b"])
+        ff = jax.nn.gelu(h @ lyr["w1"] + lyr["b1"])
+        x = x + jax.lax.psum(ff @ lyr["w2"], MODEL_AXIS) + lyr["b2"]
+
+    # Mean-pool over the (sharded) sequence, then classify.
+    pool = jax.lax.psum(x.sum(axis=1), SEQ_AXIS) / (Tl * seq_size)
+    return pool @ params["head_w"] + params["head_b"]
+
+
+def make_loss_fn(cfg: TxConfig, mesh: Mesh):
+    specs = param_specs(cfg)
+
+    def shard_fn(params, tokens, labels):
+        logits = forward_shard(params, tokens, cfg=cfg)
+        logp = jax.nn.log_softmax(logits)
+        local = -jnp.take_along_axis(logp, labels[:, None], axis=1).sum()
+        n = jax.lax.psum(jnp.float32(labels.shape[0]), DATA_AXIS)
+        return jax.lax.psum(local, DATA_AXIS) / n
+
+    def loss_fn(params, tokens, labels):
+        return jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(specs, P(DATA_AXIS, SEQ_AXIS), P(DATA_AXIS)),
+            out_specs=P())(params, tokens, labels)
+
+    return loss_fn
+
+
+def make_train_step(cfg: TxConfig, mesh: Mesh, opt: optax.GradientTransformation):
+    loss_fn = make_loss_fn(cfg, mesh)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return train_step
+
+
+def shard_params(params, cfg: TxConfig, mesh: Mesh):
+    """Place a host/param pytree on the mesh per param_specs."""
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+# --- single-device numerics oracle (tests) --------------------------------
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_reference(params, tokens, *, cfg: TxConfig):
+    """Unsharded forward: same math, no mesh — must match forward_shard."""
+    from learningorchestra_tpu.parallel.ring_attention import (
+        reference_attention)
+
+    Tl = tokens.shape[1]
+    if Tl > cfg.max_len:
+        raise ValueError(f"sequence length {Tl} exceeds max_len "
+                         f"{cfg.max_len}")
+    x = params["embed"][tokens] + params["pos"][jnp.arange(Tl)][None]
+    for lyr in params["layers"]:
+        h = _ln(x, lyr["ln1_g"], lyr["ln1_b"])
+        qkv = jnp.einsum("btd,dkhe->btkhe", h, lyr["wqkv"])
+        attn = reference_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                                   causal=cfg.causal)
+        x = x + jnp.einsum("bthe,hed->btd", attn, lyr["wo"])
+        h = _ln(x, lyr["ln2_g"], lyr["ln2_b"])
+        x = x + jax.nn.gelu(h @ lyr["w1"] + lyr["b1"]) @ lyr["w2"] + lyr["b2"]
+    pool = x.mean(axis=1)
+    return pool @ params["head_w"] + params["head_b"]

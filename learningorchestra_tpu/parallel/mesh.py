@@ -33,28 +33,39 @@ from learningorchestra_tpu.config import Settings, settings as global_settings
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+#: Sequence/context-parallel axis: long sequences shard their length across
+#: it and attention runs as a ring over ICI (parallel/ring_attention.py).
+SEQ_AXIS = "seq"
 
 
 def local_mesh(cfg: Optional[Settings] = None,
                devices=None) -> Mesh:
-    """Build the (data, model) mesh over the given (default: all) devices.
+    """Build the (data, model, seq) mesh over the given (default: all)
+    devices.
 
     Default layout puts every device on the data axis — the reference's
-    pure-data-parallel Spark layout. ``cfg.mesh_shape = "D,M"`` forces a 2-D
-    layout (e.g. "4,2" on 8 devices for data×model sharding).
+    pure-data-parallel Spark layout. ``cfg.mesh_shape = "D,M"`` or
+    ``"D,M,S"`` forces the layout (e.g. "2,2,2" on 8 devices for
+    data×model×seq sharding; the seq axis defaults to 1).
     """
     cfg = cfg or global_settings
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if cfg.mesh_shape:
-        d, m = (int(x) for x in cfg.mesh_shape.split(","))
-        if d * m != n:
+        dims = [int(x) for x in cfg.mesh_shape.split(",")]
+        if len(dims) not in (2, 3):
+            raise ValueError(
+                f"mesh_shape {cfg.mesh_shape!r} must be 'D,M' or 'D,M,S'")
+        if len(dims) == 2:
+            dims.append(1)                      # no seq axis requested
+        d, m, s = dims
+        if d * m * s != n:
             raise ValueError(
                 f"mesh_shape {cfg.mesh_shape} != device count {n}")
     else:
-        d, m = n, 1
-    arr = mesh_utils.create_device_mesh((d, m), devices=devices)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+        d, m, s = n, 1, 1
+    arr = mesh_utils.create_device_mesh((d, m, s), devices=devices)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
 def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:

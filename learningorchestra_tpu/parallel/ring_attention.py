@@ -1,0 +1,121 @@
+"""Ring attention — sequence/context parallelism over the mesh ``seq`` axis.
+
+The reference has no sequence models (SURVEY.md §5 "long-context: absent"),
+but long-context scaling is first-class here: sequences longer than one
+chip's HBM shard their *length* across the ``seq`` mesh axis, and exact
+attention runs as a ring — each device keeps its Q shard resident while
+K/V blocks rotate one hop per step via ``jax.lax.ppermute`` over ICI,
+accumulating the softmax online (the numerically-stable m/l/o recurrence
+of FlashAttention, applied block-wise). After ``seq`` steps every Q block
+has seen every K/V block: exact attention, O(T/P) memory per device, and
+the K/V transfer overlaps the attention matmuls of the previous block.
+
+Causal masking uses global positions, so rotation order never changes
+results: the block arriving at step ``t`` came from ring position
+``(my_index − t) mod P`` and its keys carry that offset.
+
+This module is mesh-agnostic: functions are written per-shard and must run
+inside ``shard_map`` with the sequence axis named by ``axis_name``
+(models/transformer.py wires it into a full training step; tests run it on
+the 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _online_block(q, k_blk, v_blk, o, m, l, mask):
+    """Fold one K/V block into the (o, m, l) online-softmax accumulators.
+
+    q: (B, Tq, H, D); k_blk/v_blk: (B, Tk, H, D); o: (B, Tq, H, D);
+    m, l: (B, Tq, H); mask: (Tq, Tk) additive (0 or -inf) or None.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    if mask is not None:
+        s = s + mask[None, None, :, :]
+    m_blk = s.max(axis=-1)                                  # (B, H, Tq)
+    m_new = jnp.maximum(m, m_blk.transpose(0, 2, 1))        # (B, Tq, H)
+    # exp shift factors; rows that have seen only -inf stay zeroed via l.
+    alpha = jnp.exp(m - m_new)                              # (B, Tq, H)
+    p = jnp.exp(s - m_new.transpose(0, 2, 1)[..., None])    # (B, H, Tq, Tk)
+    l = l * alpha + p.sum(axis=-1).transpose(0, 2, 1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+    o = o * alpha[..., None] + pv
+    return o, m_new, l
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
+    """Exact multi-head attention with sequence sharded over ``axis_name``.
+
+    Per-shard shapes (inside shard_map): q, k, v — (B, T_local, H, D).
+    Returns (B, T_local, H, D). With a size-1 axis this degrades to plain
+    single-device attention (the mask path still applies causality).
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+
+    # Derive the accumulators from q arithmetically so they inherit q's
+    # varying-axes type (shard_map's vma tracking): a literal zeros_like
+    # would be unvarying and reject the scan carry.
+    qf = q.astype(jnp.float32)
+    o = qf * 0.0
+    m = qf[..., 0] * 0.0 - jnp.inf                          # (B, Tq, H)
+    l = qf[..., 0] * 0.0
+
+    q_pos = my_idx * Tq + jnp.arange(Tq)
+
+    def fold(o, m, l, k_blk, v_blk, t):
+        if causal:
+            # The block held at step t originated at ring position
+            # (my_idx - t) mod P; its keys carry that global offset.
+            src = (my_idx - t) % axis_size
+            k_pos = src * Tk + jnp.arange(Tk)
+            mask = jnp.where(k_pos[None, :] > q_pos[:, None],
+                             -jnp.inf, 0.0).astype(jnp.float32)
+        else:
+            mask = None
+        return _online_block(qf, k_blk.astype(jnp.float32),
+                             v_blk.astype(jnp.float32), o, m, l, mask)
+
+    # Own block first, then rotate-then-fold for the remaining P-1 hops —
+    # no wasted final ppermute whose result would be discarded.
+    o, m, l = fold(o, m, l, k, v, 0)
+
+    def step(carry, t):
+        o, m, l, k_blk, v_blk = carry
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        o, m, l = fold(o, m, l, k_blk, v_blk, t)
+        return (o, m, l, k_blk, v_blk), None
+
+    if axis_size > 1:
+        (o, m, l, _, _), _ = jax.lax.scan(
+            jax.checkpoint(step), (o, m, l, k, v),
+            jnp.arange(1, axis_size))
+    # Fully-masked rows (can't happen causally: a row always sees itself)
+    # would have l == 0; guard anyway.
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+@partial(jax.jit, static_argnames=("causal",))
+def reference_attention(q, k, v, *, causal: bool = False):
+    """Unsharded full attention — the numerics oracle for tests."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -24,7 +24,11 @@ def test_mesh_shape_override():
     cfg = Settings()
     cfg.mesh_shape = "4,2"
     mesh = local_mesh(cfg)
-    assert mesh.shape == {"data": 4, "model": 2}
+    assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+
+    cfg.mesh_shape = "2,2,2"
+    mesh = local_mesh(cfg)
+    assert mesh.shape == {"data": 2, "model": 2, "seq": 2}
 
 
 def test_pad_and_shard(runtime):

@@ -147,12 +147,13 @@ def test_error_paths(served):
 
 
 def test_cluster_and_jobs_routes(served):
-    ctx, app, _ = served
+    ctx, app, csv_path = served
     import requests
 
     info = requests.get(ctx.url("/cluster")).json()
     assert info["mesh"]["data"] == 8
     assert info["platform"] == "cpu"
+    DatabaseApi(ctx).create_file("jobs_probe", csv_path, wait=True)
     jobs = requests.get(ctx.url("/jobs")).json()
     assert any(j["kind"] == "ingest" for j in jobs)
 
