@@ -257,3 +257,23 @@ class Model(_ServiceClient):
                 self.waiter.wait(f"{prediction_filename}_{c}",
                                  tolerate_missing=True)
         return out
+
+    # -- persisted-model registry (upgrade: reference discards models) ------
+
+    def list_trained_models(self) -> List[Dict]:
+        return ResponseTreat.treatment(
+            requests.get(self.context.url("/trained-models")))
+
+    def predict(self, model_name: str, dataset_name: str,
+                prediction_filename: str) -> Dict:
+        """Apply a persisted model (``<prediction>_<classifier>`` from a
+        previous create_model) to any stored dataset."""
+        self.waiter.wait(dataset_name)
+        return ResponseTreat.treatment(requests.post(
+            self.context.url(f"/trained-models/{model_name}/predictions"),
+            json={"dataset_name": dataset_name,
+                  "prediction_filename": prediction_filename}))
+
+    def delete_trained_model(self, model_name: str) -> Dict:
+        return ResponseTreat.treatment(requests.delete(
+            self.context.url(f"/trained-models/{model_name}")))

@@ -99,6 +99,19 @@ class Settings:
     allow_exec_preprocessing: bool = field(
         default_factory=lambda: _env("LO_TPU_ALLOW_EXEC", False, bool)
     )
+    #: Checkpoint fitted models (orbax) into store_root/_models so they can
+    #: be listed and re-used for prediction. The reference discards models
+    #: after use (model_builder.py:227-248) — this is the §5 upgrade.
+    persist_models: bool = field(
+        default_factory=lambda: _env("LO_TPU_PERSIST_MODELS", True, bool)
+    )
+
+    # --- observability -----------------------------------------------------
+    #: When set, compute jobs run under jax.profiler.trace writing
+    #: TensorBoard-loadable device traces here.
+    profile_dir: str = field(
+        default_factory=lambda: _env("LO_TPU_PROFILE_DIR", "")
+    )
 
     def replace(self, **kw) -> "Settings":
         new = Settings()

@@ -22,6 +22,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from learningorchestra_tpu.utils.profiling import op_timer
+
 
 @dataclass
 class JobRecord:
@@ -99,6 +101,8 @@ class JobManager:
                         pass
             finally:
                 rec.finished_at = time.time()
+                op_timer.record(f"job.{kind}",
+                                rec.finished_at - rec.started_at)
 
         future: Future = self._pool.submit(run)
         rec._future = future  # type: ignore[attr-defined]

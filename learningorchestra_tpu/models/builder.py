@@ -29,9 +29,12 @@ from learningorchestra_tpu.catalog.store import DatasetStore
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.models.base import FitReport, Timer
 from learningorchestra_tpu.models.metrics import classification_metrics
+from learningorchestra_tpu.models.persistence import ModelRegistry
 from learningorchestra_tpu.models.registry import get_trainer
 from learningorchestra_tpu.ops import preprocess
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.utils.profiling import (
+    device_trace, op_timer, timed)
 
 
 class ModelBuilder:
@@ -40,6 +43,7 @@ class ModelBuilder:
         self.store = store
         self.runtime = runtime
         self.cfg = cfg or global_settings
+        self.registry = ModelRegistry(self.cfg)
 
     # -- validation (reference model_builder.py:272-292) ---------------------
 
@@ -75,6 +79,7 @@ class ModelBuilder:
         test_ds = self.store.get(test)
         hparams = hparams or {}
 
+        pp_meta = None
         if preprocessor_code is not None:
             if not self.cfg.allow_exec_preprocessing:
                 raise PermissionError(
@@ -89,6 +94,10 @@ class ModelBuilder:
             X_test, y_test, _, _ = preprocess.design_matrix(
                 test_ds, label, steps, state=state,
                 feature_fields=feature_fields)
+            # Everything needed to apply the identical pipeline to future
+            # datasets when the fitted model is re-served (persistence.py).
+            pp_meta = {"steps": list(steps), "state": state,
+                       "feature_fields": feature_fields, "label": label}
         if y_train is None:
             raise ValueError(f"label field {label!r} not in {train!r}")
         num_classes = int(max(int(y_train.max()) + 1,
@@ -107,17 +116,24 @@ class ModelBuilder:
                 model = trainer(self.runtime, X_train, y_train, num_classes,
                                 **hparams.get(c, {}))
                 probs = model.predict_proba(self.runtime, X_test)
+            op_timer.record(f"fit.{c}", t.elapsed)
             preds = np.argmax(probs, axis=1)
             report = FitReport(kind=c, fit_time=t.elapsed)
             if y_test is not None and (y_test >= 0).all():
                 report.metrics = classification_metrics(
                     y_test, preds, num_classes)
+            if self.cfg.persist_models:
+                self.registry.save(f"{prediction_name}_{c}", model,
+                                   metrics=report.metrics,
+                                   preprocess=pp_meta)
             self._save_predictions(f"{prediction_name}_{c}", test_ds,
                                    preds, probs, report)
             return report
 
         # Concurrent fits (reference: 5-way ThreadPoolExecutor + FAIR pool).
-        with ThreadPoolExecutor(
+        # One device trace spans the whole build (JAX allows a single
+        # active trace per process, so per-fit tracing would collide).
+        with device_trace(self.cfg), ThreadPoolExecutor(
                 max_workers=self.cfg.max_concurrent_fits) as pool:
             futures = {c: pool.submit(fit_one, c) for c in classifiers}
             reports = []
@@ -130,6 +146,29 @@ class ModelBuilder:
                     reports.append(FitReport(kind=c, fit_time=0.0,
                                              metrics={"error": str(exc)}))
         return reports
+
+    def predict(self, model_name: str, dataset: str, out_name: str) -> None:
+        """Serve a persisted model on a stored dataset: apply its train-time
+        preprocessing state, predict, and write a prediction dataset — the
+        re-use path the reference lacks entirely (models were discarded,
+        reference model_builder.py:227-248)."""
+        man, model = self.registry.load(model_name)
+        pp = man.get("preprocess")
+        if pp is None:
+            raise ValueError(
+                f"model {model_name} was exec-preprocessed; it carries no "
+                "reproducible preprocessing state to apply to new datasets")
+        ds = self.store.get(dataset)
+        with timed("model_predict"), device_trace(self.cfg):
+            X, _, _, _ = preprocess.design_matrix(
+                ds, pp["label"], pp["steps"], state=pp["state"],
+                feature_fields=pp["feature_fields"])
+            probs = model.predict_proba(self.runtime, X)
+        preds = np.argmax(probs, axis=1)
+        self.store.create(out_name, parent=dataset,
+                          extra={"model": model_name, "kind": man["kind"]})
+        self._save_predictions(out_name, ds, preds, probs,
+                               FitReport(kind=man["kind"], fit_time=0.0))
 
     def _save_predictions(self, name: str, test_ds, preds: np.ndarray,
                           probs: np.ndarray, report: FitReport) -> None:

@@ -1,0 +1,123 @@
+"""Model persistence — the capability upgrade SURVEY.md §5 calls for.
+
+The reference *discards* every fitted model: only predictions and metrics
+survive (reference model_builder.py:227-248); there is no way to re-use a
+classifier on new data. Here every successful fit checkpoints its
+parameter pytree with orbax (the TPU-native checkpoint layer: async-safe
+array serialization, sharding-aware restore) plus a JSON manifest carrying
+everything needed to serve it again: classifier kind, hparams (the static
+args of its predictor), the fitted preprocessing state (vocabularies, fill
+values, standardization stats), and the training metrics.
+
+``ModelRegistry.load`` rebuilds a ``TrainedModel`` whose predictor comes
+from ``registry.predictor_for`` — so a persisted model predicts on any
+stored dataset through POST /trained-models/<name>/predictions with the
+exact train-time preprocessing applied.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from learningorchestra_tpu.catalog.store import validate_name
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.models.registry import predictor_for
+
+
+class ModelNotFound(KeyError):
+    pass
+
+
+class ModelRegistry:
+    """Disk-backed registry of fitted models under ``store_root/_models``."""
+
+    def __init__(self, cfg: Settings):
+        self.cfg = cfg
+        self.root = os.path.join(cfg.store_root, "_models")
+        self._lock = threading.Lock()
+
+    def _dir(self, name: str) -> str:
+        validate_name(name)
+        return os.path.join(self.root, name)
+
+    # -- write ---------------------------------------------------------------
+
+    def save(self, name: str, model: TrainedModel,
+             metrics: Optional[Dict[str, float]] = None,
+             preprocess: Optional[Dict[str, Any]] = None) -> None:
+        import orbax.checkpoint as ocp
+
+        d = self._dir(name)
+        with self._lock:
+            if os.path.isdir(d):
+                shutil.rmtree(d)
+            os.makedirs(d)
+            ocp.PyTreeCheckpointer().save(
+                os.path.join(d, "params"), model.params)
+            manifest = {
+                "name": name,
+                "kind": model.kind,
+                "num_classes": model.num_classes,
+                "hparams": model.hparams,
+                "metrics": metrics or {},
+                "preprocess": preprocess,
+                "time_created": time.strftime("%Y-%m-%d %H:%M:%S"),
+            }
+            with open(os.path.join(d, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=1)
+
+    # -- read ----------------------------------------------------------------
+
+    def manifest(self, name: str) -> Dict[str, Any]:
+        path = os.path.join(self._dir(name), "manifest.json")
+        if not os.path.exists(path):
+            raise ModelNotFound(name)
+        with open(path) as f:
+            return json.load(f)
+
+    def load(self, name: str) -> Tuple[Dict[str, Any], TrainedModel]:
+        import jax
+        import numpy as np
+        import orbax.checkpoint as ocp
+
+        man = self.manifest(name)
+        params = ocp.PyTreeCheckpointer().restore(
+            os.path.join(self._dir(name), "params"))
+        # Restore to host arrays: orbax would otherwise pin each leaf to
+        # the sharding it was saved with, which may mix device placements
+        # (and may not exist on the restoring topology at all). Predict
+        # jits re-place them wherever the serving mesh lives.
+        params = jax.tree.map(np.asarray, params)
+        model = TrainedModel(
+            kind=man["kind"], params=params,
+            predict_proba_fn=predictor_for(man["kind"], man["hparams"]),
+            num_classes=man["num_classes"], hparams=man["hparams"])
+        return man, model
+
+    def list(self) -> List[Dict[str, Any]]:
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            try:
+                out.append(self.manifest(name))
+            except (ModelNotFound, json.JSONDecodeError, ValueError):
+                # Stray entries (temp files, invalid names) are not models.
+                continue
+        return out
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self._dir(name), "manifest.json"))
+
+    def delete(self, name: str) -> None:
+        d = self._dir(name)
+        with self._lock:
+            if not os.path.isdir(d):
+                raise ModelNotFound(name)
+            shutil.rmtree(d)

@@ -29,3 +29,28 @@ def get_trainer(name: str) -> Callable:
         raise ValueError(
             f"invalid classifier {name!r}; choose from "
             f"{sorted(CLASSIFIERS)}") from None
+
+
+def predictor_for(kind: str, hparams: Dict) -> Callable:
+    """Rebuild the (params, X) -> probs function for a persisted model.
+
+    Every family's predictor is a module function parameterized only by
+    static hparams, so a checkpoint of (kind, hparams, params) fully
+    reconstructs a servable model (models/persistence.py)."""
+    from functools import partial
+
+    from learningorchestra_tpu.models import trees
+
+    if kind in ("dt", "rf"):
+        return partial(trees._forest_proba_static,
+                       max_depth=int(hparams["max_depth"]))
+    if kind == "gb":
+        return partial(trees._gbt_proba_static,
+                       max_depth=int(hparams["max_depth"]))
+    if kind == "lr":
+        return logistic._predict_proba
+    if kind == "nb":
+        return naive_bayes._predict_proba
+    if kind == "mlp":
+        return mlp._predict_proba
+    raise ValueError(f"no predictor for classifier kind {kind!r}")

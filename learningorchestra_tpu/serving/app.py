@@ -196,6 +196,28 @@ class App:
             return 201, {"result": "model build started",
                          "prediction_datasets": pred_datasets}
 
+        # ---- trained-model registry (upgrade: the reference discards
+        # fitted models, SURVEY.md §5; here they persist + re-serve)
+        @self._route("GET", "/trained-models")
+        def list_trained_models(_req):
+            return 200, app.builder.registry.list()
+
+        @self._route("DELETE", "/trained-models/{name}")
+        def delete_trained_model(req):
+            app.builder.registry.delete(req.params["name"])
+            return 200, {"result": "deleted"}
+
+        @self._route("POST", "/trained-models/{name}/predictions")
+        def model_predict(req):
+            name = req.params["name"]
+            dataset, out = req.require("dataset_name", "prediction_filename")
+            if app.store.exists(out):
+                raise DatasetExists(out)
+            app.builder.predict(name, dataset, out)
+            meta = app.store.read(out, limit=1)[0]
+            return 201, {"result": f"predictions written to {out}",
+                         "metadata": meta}
+
         # ---- tsne / pca images (reference tsne_image/server.py:57-155)
         for method in ("tsne", "pca"):
             self._register_images(method)
@@ -210,6 +232,18 @@ class App:
         @self._route("GET", "/jobs")
         def jobs(_req):
             return 200, app.jobs.records()
+
+        @self._route("GET", "/metrics")
+        def metrics(_req):
+            from learningorchestra_tpu.utils.profiling import op_timer
+
+            recs = app.jobs.records()
+            by_status: dict = {}
+            for r in recs:
+                by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+            return 200, {"ops": op_timer.snapshot(),
+                         "jobs": by_status,
+                         "profile_dir": app.cfg.profile_dir or None}
 
     def _register_images(self, method: str) -> None:
         app = self

@@ -1,0 +1,81 @@
+"""Profiling + op timing — the observability tier SURVEY.md §5 calls for.
+
+The reference's only performance instrumentation is the per-model
+``fit_time`` wall clock persisted with results (reference
+model_builder.py:199-204); everything else was delegated to Spark's web
+UIs. Here:
+
+- every framework operation (ingest, projection, histogram, each model
+  fit, each embedding) records its wall-clock into a process-wide
+  ``OpTimer``; aggregates are served at GET /metrics alongside job stats;
+- setting ``LO_TPU_PROFILE_DIR`` wraps compute jobs in
+  ``jax.profiler.trace`` so every XLA op, transfer, and collective lands
+  in a TensorBoard-loadable trace — the device-level view Spark's stage UI
+  approximated.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from learningorchestra_tpu.config import Settings
+
+
+class OpTimer:
+    """Thread-safe aggregate wall-clock stats per operation name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            s = self._stats.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            s["count"] += 1
+            s["total_s"] += seconds
+            s["max_s"] = max(s["max_s"], seconds)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {**s, "mean_s": s["total_s"] / max(s["count"], 1)}
+                for name, s in self._stats.items()
+            }
+
+
+#: Process-global timer (one server process = one metrics surface).
+op_timer = OpTimer()
+
+
+@contextmanager
+def timed(name: str, timer: Optional[OpTimer] = None):
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        (timer or op_timer).record(name, time.time() - t0)
+
+
+#: JAX allows one active profiler trace per process; concurrent jobs that
+#: both request tracing serialize on this lock instead of crashing.
+_trace_lock = threading.Lock()
+
+
+@contextmanager
+def device_trace(cfg: Settings):
+    """jax.profiler trace around a compute job when profile_dir is set.
+
+    Wrap whole jobs (a full multi-classifier build, one predict call) —
+    not per-thread work items — so a trace covers a meaningful span.
+    """
+    if not cfg.profile_dir:
+        yield
+        return
+    import jax
+
+    with _trace_lock, jax.profiler.trace(cfg.profile_dir):
+        yield

@@ -1,0 +1,103 @@
+"""Model persistence (orbax) + re-serving + metrics observability."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from learningorchestra_tpu.models.builder import ModelBuilder  # noqa: E402
+from learningorchestra_tpu.models.persistence import (  # noqa: E402
+    ModelNotFound, ModelRegistry)
+from learningorchestra_tpu.parallel.mesh import MeshRuntime  # noqa: E402
+
+
+def _toy_columns(n, seed):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    sex = rng.choice(["a", "b"], n).astype(object)
+    y = ((x1 + (sex == "b") * 1.5 + rng.normal(0, 0.3, n)) > 0.7).astype(
+        np.int64)
+    return {"x1": x1, "x2": x2, "sex": sex, "label": y}
+
+
+@pytest.fixture()
+def built(store, cfg):
+    runtime = MeshRuntime(cfg)
+    cfg.persist_models = True
+    store.create("pt_train", columns=_toy_columns(400, 0), finished=True)
+    store.create("pt_test", columns=_toy_columns(100, 1), finished=True)
+    mb = ModelBuilder(store, runtime, cfg)
+    reports = mb.build("pt_train", "pt_test", "ptm", ["lr", "dt"], "label")
+    return mb, reports
+
+
+def test_roundtrip_predictions_identical(built, store):
+    """A restored model must reproduce the exact predictions the live
+    model wrote, including the train-time preprocessing state."""
+    mb, reports = built
+    assert {r.kind for r in reports} == {"lr", "dt"}
+    assert all(r.metrics["accuracy"] > 0.7 for r in reports)
+
+    names = [m["name"] for m in mb.registry.list()]
+    assert sorted(names) == ["ptm_dt", "ptm_lr"]
+    man = mb.registry.manifest("ptm_lr")
+    assert man["kind"] == "lr" and man["preprocess"]["label"] == "label"
+
+    mb.predict("ptm_lr", "pt_test", "served_lr")
+    live = [r["prediction"] for r in
+            store.read("served_lr", skip=1, limit=20)]
+    orig = [r["prediction"] for r in store.read("ptm_lr", skip=1, limit=20)]
+    assert live == orig
+    assert store.get("served_lr").metadata.finished
+
+
+def test_forest_predictor_rebuilds_from_hparams(built, store):
+    """dt/rf/gb predictors carry static args (max_depth) in hparams; a
+    fresh registry instance (new process) must rebuild them."""
+    mb, _ = built
+    reg2 = ModelRegistry(mb.cfg)
+    man, model = reg2.load("ptm_dt")
+    cols = _toy_columns(50, 2)
+    X = np.stack([cols["x1"], cols["x2"],
+                  (cols["sex"] == "b").astype(np.float64)], axis=1)
+    probs = model.predict_proba(mb.runtime, X.astype(np.float32))
+    assert probs.shape == (50, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_delete_and_missing(built):
+    mb, _ = built
+    mb.registry.delete("ptm_dt")
+    assert not mb.registry.exists("ptm_dt")
+    with pytest.raises(ModelNotFound):
+        mb.registry.load("ptm_dt")
+
+
+def test_exec_models_refuse_dataset_predict(store, cfg):
+    runtime = MeshRuntime(cfg)
+    cfg.persist_models = True
+    cfg.allow_exec_preprocessing = True
+    store.create("pe_train", columns=_toy_columns(200, 3), finished=True)
+    store.create("pe_test", columns=_toy_columns(50, 4), finished=True)
+    mb = ModelBuilder(store, runtime, cfg)
+    code = (
+        "import numpy as np\n"
+        "features_training = np.stack([training_df['x1'],"
+        " training_df['x2']], 1)\n"
+        "labels_training = training_df['label'].to_numpy()\n"
+        "features_testing = np.stack([testing_df['x1'],"
+        " testing_df['x2']], 1)\n"
+        "labels_testing = testing_df['label'].to_numpy()\n")
+    mb.build("pe_train", "pe_test", "pem", ["lr"], "label",
+             preprocessor_code=code)
+    with pytest.raises(ValueError, match="exec-preprocessed"):
+        mb.predict("pem_lr", "pe_test", "pe_out")
+
+
+def test_op_timer_records_fits(built):
+    from learningorchestra_tpu.utils.profiling import op_timer
+
+    snap = op_timer.snapshot()
+    assert snap["fit.lr"]["count"] >= 1
+    assert snap["fit.lr"]["total_s"] > 0

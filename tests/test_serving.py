@@ -218,3 +218,36 @@ def test_async_build_failure_is_pollable(served):
             db.waiter.wait(name, tolerate_missing=True)
         meta = db.read_file(name, limit=1)[0]
         assert meta["finished"] is True and meta["error"]
+
+
+def test_trained_model_registry_routes(served):
+    """Fit persists models; they list, re-serve on new data, and delete."""
+    import requests
+
+    ctx, app, csv_path = served
+    db = DatabaseApi(ctx)
+    db.create_file("tmr_train", csv_path, wait=True)
+    m = Model(ctx)
+    m.create_model("tmr_train", "tmr_train", "tmr", ["lr"], "Survived")
+
+    names = [x["name"] for x in m.list_trained_models()]
+    assert "tmr_lr" in names
+
+    out = m.predict("tmr_lr", "tmr_train", "tmr_served")
+    assert out["metadata"]["finished"] is True
+    row = db.read_file("tmr_served", skip=1, limit=1)[0]
+    assert row["prediction"] in (0, 1)
+
+    # duplicate output name → 409
+    with pytest.raises(RuntimeError, match="409"):
+        m.predict("tmr_lr", "tmr_train", "tmr_served")
+    # unknown model → 404
+    with pytest.raises(RuntimeError, match="404"):
+        m.predict("no_such_model", "tmr_train", "tmr_x")
+
+    m.delete_trained_model("tmr_lr")
+    assert "tmr_lr" not in [x["name"] for x in m.list_trained_models()]
+
+    metrics = requests.get(ctx.url("/metrics")).json()
+    assert metrics["ops"]["fit.lr"]["count"] >= 1
+    assert metrics["jobs"].get("done", 0) >= 1
