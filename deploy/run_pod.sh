@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Launch the service across every host of a TPU pod slice — the analogue
+# of the reference's run.sh (build + push + `docker stack deploy`,
+# reference run.sh:8-38), with the registry/Swarm/Mongo/Spark tiers gone:
+# the same server binary runs on each host and jax.distributed joins them
+# into one device mesh.
+#
+# Usage:
+#   deploy/run_pod.sh                      # single host, all local chips
+#   COORDINATOR=host0:8476 NUM_HOSTS=4 HOST_ID=2 deploy/run_pod.sh
+#
+# On Cloud TPU pod slices, prefer the gcloud fan-out (topology
+# auto-discovered, no env needed):
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+#     --command="cd app && deploy/run_pod.sh"
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${PORT:-5000}"
+
+if [[ -n "${COORDINATOR:-}" ]]; then
+  export LO_TPU_COORDINATOR="$COORDINATOR"
+  export LO_TPU_NUM_PROCESSES="${NUM_HOSTS:?set NUM_HOSTS with COORDINATOR}"
+  export LO_TPU_PROCESS_ID="${HOST_ID:?set HOST_ID with COORDINATOR}"
+  echo "joining mesh: process $LO_TPU_PROCESS_ID/$LO_TPU_NUM_PROCESSES" \
+       "via $LO_TPU_COORDINATOR"
+fi
+
+make -C native >/dev/null 2>&1 || true   # native CSV parser (optional)
+exec python -m learningorchestra_tpu.serving --host 0.0.0.0 --port "$PORT"
