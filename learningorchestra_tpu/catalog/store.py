@@ -102,7 +102,13 @@ class DatasetStore:
                             finished=finished, extra=dict(extra or {}))
             ds = Dataset(meta, columns)
             self._datasets[name] = ds
-            return ds
+        if self.cfg.persist:
+            # Persist the metadata-first state immediately: a crash between
+            # create and commit must leave a recoverable record, so restart
+            # can mark the job interrupted instead of losing the dataset
+            # (pollers would 404 forever otherwise).
+            self.save(name)
+        return ds
 
     def get(self, name: str) -> Dataset:
         with self._lock:
@@ -262,7 +268,13 @@ class DatasetStore:
         return ds
 
     def load_all(self) -> List[str]:
-        """Recover the catalog from disk at startup (crash resume)."""
+        """Recover the catalog from disk at startup (crash resume).
+
+        Datasets recovered with ``finished: false`` were mid-job when the
+        process died; their jobs are gone, so they are marked failed —
+        every dataset reaches a terminal state across restarts (the
+        reference left finished:false forever, SURVEY.md §5).
+        """
         root = self.cfg.store_root
         loaded = []
         if os.path.isdir(root):
@@ -270,6 +282,10 @@ class DatasetStore:
                 if os.path.isfile(os.path.join(root, name, "metadata.json")):
                     self.load(name)
                     loaded.append(name)
+        for name in loaded:
+            ds = self.get(name)
+            if not ds.metadata.finished and not ds.metadata.error:
+                self.fail(name, "interrupted: server restarted mid-job")
         return loaded
 
 

@@ -30,10 +30,23 @@ class TrainedModel:
     num_classes: int
     hparams: Dict[str, Any] = field(default_factory=dict)
 
+    #: Rows per device predict call — bounds transient device memory on
+    #: huge test sets (an (n, C)-shaped probability tensor lane-pads its
+    #: trailing dim to 128 on TPU, so n must stay bounded).
+    PREDICT_CHUNK = 2_000_000
+
     def predict_proba(self, runtime: MeshRuntime, X: np.ndarray) -> np.ndarray:
-        X_dev, n = runtime.shard_rows(np.asarray(X, np.float32))
-        probs = self.predict_proba_fn(self.params, X_dev)
-        return np.asarray(probs)[:n]
+        X = np.asarray(X, np.float32)
+        if len(X) <= self.PREDICT_CHUNK:
+            X_dev, n = runtime.shard_rows(X)
+            return np.asarray(self.predict_proba_fn(self.params, X_dev))[:n]
+        outs = []
+        for i in range(0, len(X), self.PREDICT_CHUNK):
+            chunk = np.ascontiguousarray(X[i:i + self.PREDICT_CHUNK])
+            X_dev, n = runtime.shard_rows(chunk)
+            outs.append(
+                np.asarray(self.predict_proba_fn(self.params, X_dev))[:n])
+        return np.concatenate(outs, axis=0)
 
     def predict(self, runtime: MeshRuntime, X: np.ndarray) -> np.ndarray:
         return np.argmax(self.predict_proba(runtime, X), axis=1)

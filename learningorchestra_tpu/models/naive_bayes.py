@@ -30,10 +30,13 @@ _VAR_FLOOR = 1e-6
 def _fit(X, y, n_valid, *, num_classes, smoothing):
     n, d = X.shape
     mask = (jnp.arange(n) < n_valid).astype(jnp.float32)
-    onehot = jax.nn.one_hot(y, num_classes, dtype=jnp.float32) * mask[:, None]
-    counts = onehot.sum(axis=0)                      # (C,)
-    sums = onehot.T @ X                              # (C, d) — MXU contraction
-    sqsums = onehot.T @ (X * X)                      # (C, d)
+    # One-hot built transposed (C, n) — the long row axis sits in lanes;
+    # an (n, C<128) layout would lane-pad to 128 columns (GBs at 11M rows).
+    classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
+    onehot_T = (y[None, :] == classes).astype(jnp.float32) * mask[None, :]
+    counts = onehot_T.sum(axis=1)                    # (C,)
+    sums = onehot_T @ X                              # (C, d) — MXU contraction
+    sqsums = onehot_T @ (X * X)                      # (C, d)
     denom = jnp.maximum(counts, 1.0)[:, None]
     mean = sums / denom
     var = jnp.maximum(sqsums / denom - mean ** 2, _VAR_FLOOR) + smoothing
@@ -44,9 +47,15 @@ def _fit(X, y, n_valid, *, num_classes, smoothing):
 @jax.jit
 def _predict_proba(params, X):
     mean, var, log_prior = params["mean"], params["var"], params["log_prior"]
-    # log N(x; mu, var) summed over features, per class: (n, C)
-    x2 = ((X[:, None, :] - mean[None]) ** 2) / var[None]
-    loglik = -0.5 * (x2 + jnp.log(2.0 * jnp.pi * var)[None]).sum(axis=-1)
+    # log N(x; mu, var) summed over features, per class, in expanded
+    # quadratic form: Σ_d (x−μ)²/v = x²·(1/v) − 2x·(μ/v) + Σ μ²/v.
+    # Two (n,d)@(d,C) matmuls instead of an (n, C, d) broadcast tensor
+    # (which would be gigabytes at HIGGS scale before lane padding).
+    inv_v = (1.0 / var).T                              # (d, C)
+    mu_v = (mean / var).T                              # (d, C)
+    const = ((mean ** 2 / var) + jnp.log(2.0 * jnp.pi * var)).sum(axis=1)
+    quad = (X * X) @ inv_v - 2.0 * (X @ mu_v)          # (n, C)
+    loglik = -0.5 * (quad + const[None, :])
     return jax.nn.softmax(loglik + log_prior[None], axis=-1)
 
 

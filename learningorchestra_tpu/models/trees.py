@@ -64,22 +64,42 @@ def quantile_edges(X: np.ndarray, n_bins: int,
 
 @jax.jit
 def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
-    """float features → int32 bin codes via per-feature searchsorted."""
+    """float features → uint8 bin codes via per-feature searchsorted.
+
+    uint8 keeps the resident bin matrix 4× smaller than int32 (and TPU
+    lane padding makes (n, d<128) arrays pay for 128 lanes regardless, so
+    narrow dtypes are the only lever); n_bins is capped at 256.
+    """
     codes = jax.vmap(lambda col, e: jnp.searchsorted(e, col),
                      in_axes=(1, 0))(X, edges)
-    return codes.T.astype(jnp.int32)  # (n, d)
+    return codes.T.astype(jnp.uint8)  # (n, d)
 
 
 # ---------------------------------------------------------------------------
 # Generic level-wise histogram tree builder (runs inside shard_map)
 # ---------------------------------------------------------------------------
 
-def _build_tree(B, stats, feat_gain_mask, *, max_depth, n_bins,
+#: Rows per histogram/routing block. Level-wise stats accumulate in a
+#: lax.scan over row blocks so nothing (n, d, S)-shaped ever materializes —
+#: at HIGGS scale (11M × 28) that tensor would be gigabytes *before* TPU
+#: lane padding inflates trailing small dims to 128 lanes (a (n·d, 2) f32
+#: scatter operand allocates 64× its logical size).
+_ROW_BLOCK = 1 << 18
+
+
+def _block_shape(n):
+    blk = min(_ROW_BLOCK, n)
+    nbk = -(-n // blk)
+    return blk, nbk, nbk * blk
+
+
+def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
                 gain_fn, weight_fn, min_child_weight, min_gain):
     """Grow one tree. All shapes static; call inside shard_map.
 
-    B: (n, d) int32 bin codes (local shard rows).
-    stats: (n, S) float32 per-row sufficient statistics (zero for masked
+    B: (n, d) uint8 bin codes (local shard rows).
+    stats_T: (S, n) float32 per-row sufficient statistics, TRANSPOSED so
+        the long row axis sits in TPU lanes (zero columns for masked
         rows — padding/bootstrap-excluded rows simply carry zero weight).
     feat_gain_mask: (d,) float32 — 0 allows a feature, NEG forbids it
         (random-forest per-tree feature subsampling).
@@ -90,13 +110,20 @@ def _build_tree(B, stats, feat_gain_mask, *, max_depth, n_bins,
     M = 2^(max_depth+1) - 1 nodes; children of i at 2i+1 / 2i+2.
     """
     n, d = B.shape
-    S = stats.shape[1]
+    S = stats_T.shape[0]
     M = 2 ** (max_depth + 1) - 1
+    blk, nbk, n_pad = _block_shape(n)
+    if n_pad != n:
+        B = jnp.pad(B, ((0, n_pad - n), (0, 0)))
+        stats_T = jnp.pad(stats_T, ((0, 0), (0, n_pad - n)))
+    Bb = B.reshape(nbk, blk, d)
+    stb = stats_T.reshape(S, nbk, blk).transpose(1, 0, 2)   # (nbk, S, blk)
 
     feat = jnp.zeros((M,), jnp.int32)
     thr = jnp.zeros((M,), jnp.int32)
     is_internal = jnp.zeros((M,), bool)
-    assign = jnp.zeros((n,), jnp.int32)
+    assign = jnp.zeros((n_pad,), jnp.int32)
+    bins_row = jnp.arange(n_bins, dtype=jnp.int32)[None, :]
 
     for level in range(max_depth):
         offset = 2 ** level - 1
@@ -104,16 +131,32 @@ def _build_tree(B, stats, feat_gain_mask, *, max_depth, n_bins,
         rel = assign - offset
         active = (rel >= 0) & (rel < n_level)
         rel = jnp.where(active, rel, 0)
+        relb = rel.reshape(nbk, blk)
+        actb = active.reshape(nbk, blk)
 
-        # (node, feature, bin, stat) histogram with one flat scatter-add.
-        # idx[r, f] indexes (rel, f, B[r, f]); inactive rows add zeros.
-        idx = (rel[:, None] * d + jnp.arange(d)[None, :]) * n_bins + B
-        contrib = stats[:, None, :] * active[:, None, None]      # (n, d, S)
-        contrib = jnp.broadcast_to(contrib, (n, d, S))
-        hist = jnp.zeros((n_level * d * n_bins, S), jnp.float32)
-        hist = hist.at[idx.reshape(-1)].add(contrib.reshape(-1, S))
+        # (node, feature, bin, stat) histogram as MATMULS, not scatters:
+        # TPU scatter-adds serialize, but A.T @ onehot(bins) is an MXU
+        # contraction. A packs node-masked per-row stats (blk, nl·S); one
+        # (nl·S, blk) @ (blk, n_bins) product per feature per block.
+        def hist_block(hist, inp):
+            Bblk, relblk, ablk, sblk = inp  # (blk,d) (blk,) (blk,) (S,blk)
+            node_oh = ((relblk[:, None] == jnp.arange(n_level)[None, :])
+                       & ablk[:, None])                      # (blk, nl)
+            A = (node_oh[:, :, None].astype(jnp.float32)
+                 * sblk.T[:, None, :])                       # (blk, nl, S)
+            At = A.reshape(blk, n_level * S).T               # (nl·S, blk)
+            Bi = Bblk.astype(jnp.int32)
+            per_f = [
+                At @ (Bi[:, f][:, None] == bins_row).astype(jnp.float32)
+                for f in range(d)]                           # (nl·S, n_bins)
+            return hist + jnp.stack(per_f, axis=0), None
+
+        hist, _ = jax.lax.scan(
+            hist_block, jnp.zeros((d, n_level * S, n_bins), jnp.float32),
+            (Bb, relb, actb, stb))
         hist = jax.lax.psum(hist, DATA_AXIS)                     # ICI reduce
-        hist = hist.reshape(n_level, d, n_bins, S)
+        # (d, nl·S, bins) → (nl, d, bins, S)
+        hist = hist.reshape(d, n_level, S, n_bins).transpose(1, 0, 3, 2)
 
         left = jnp.cumsum(hist, axis=2)                          # ≤ bin t
         total = left[:, :, -1:, :]                               # (nl,d,1,S)
@@ -137,32 +180,57 @@ def _build_tree(B, stats, feat_gain_mask, *, max_depth, n_bins,
         thr = thr.at[node_ids].set(jnp.where(split, best_t, 0))
         is_internal = is_internal.at[node_ids].set(split)
 
-        # Route rows of split nodes to children; leaf rows keep their node.
-        row_f = best_f[rel]
-        row_t = best_t[rel]
-        row_split = split[rel] & active
-        go_right = jnp.take_along_axis(B, row_f[:, None], axis=1)[:, 0] > row_t
-        assign = jnp.where(
-            row_split, 2 * assign + 1 + go_right.astype(jnp.int32), assign)
+        # Route rows of split nodes to children; leaf rows keep their
+        # node. Blocked for the same lane-padding reason.
+        def route_block(_, inp):
+            Bblk, relblk, ablk, asgblk = inp
+            rf = best_f[relblk]
+            rt = best_t[relblk]
+            rs = split[relblk] & ablk
+            gr = jnp.take_along_axis(
+                Bblk.astype(jnp.int32), rf[:, None], axis=1)[:, 0] > rt
+            return None, jnp.where(
+                rs, 2 * asgblk + 1 + gr.astype(jnp.int32), asgblk)
 
-    # Leaf sufficient statistics over ALL nodes (every row sits at a leaf).
-    leaf = jnp.zeros((M, S), jnp.float32).at[assign].add(stats)
-    leaf = jax.lax.psum(leaf, DATA_AXIS)
+        _, asg = jax.lax.scan(route_block, None,
+                              (Bb, relb, actb, assign.reshape(nbk, blk)))
+        assign = asg.reshape(n_pad)
+
+    # Leaf sufficient statistics over ALL nodes (every row sits at a leaf;
+    # padded columns carry zero stats) — the same matmul-histogram trick.
+    def leaf_block(acc, inp):
+        asgblk, sblk = inp                                   # (blk,), (S,blk)
+        oh = (asgblk[:, None] == jnp.arange(M)[None, :]).astype(jnp.float32)
+        return acc + sblk @ oh, None                         # (S, M)
+
+    leaf, _ = jax.lax.scan(
+        leaf_block, jnp.zeros((S, M), jnp.float32),
+        (assign.reshape(nbk, blk), stb))
+    leaf = jax.lax.psum(leaf.T, DATA_AXIS)                   # (M, S)
     return feat, thr, is_internal, leaf
 
 
 def _descend(B, feat, thr, is_internal, max_depth):
-    """Vectorized routing of binned rows to their leaf node id."""
-    n = B.shape[0]
-    assign = jnp.zeros((n,), jnp.int32)
-    for _ in range(max_depth):
-        f = feat[assign]
-        t = thr[assign]
-        internal = is_internal[assign]
-        go_right = jnp.take_along_axis(B, f[:, None], axis=1)[:, 0] > t
-        assign = jnp.where(
-            internal, 2 * assign + 1 + go_right.astype(jnp.int32), assign)
-    return assign
+    """Blocked routing of binned rows to their leaf node id."""
+    n, d = B.shape
+    blk, nbk, n_pad = _block_shape(n)
+    if n_pad != n:
+        B = jnp.pad(B, ((0, n_pad - n), (0, 0)))
+
+    def desc_block(_, Bblk):
+        a = jnp.zeros((Bblk.shape[0],), jnp.int32)
+        for _ in range(max_depth):
+            f = feat[a]
+            t = thr[a]
+            internal = is_internal[a]
+            go_right = jnp.take_along_axis(
+                Bblk.astype(jnp.int32), f[:, None], axis=1)[:, 0] > t
+            a = jnp.where(internal, 2 * a + 1 + go_right.astype(jnp.int32),
+                          a)
+        return None, a
+
+    _, a = jax.lax.scan(desc_block, None, B.reshape(nbk, blk, d))
+    return a.reshape(n_pad)[:n]
 
 
 # ---------------------------------------------------------------------------
@@ -212,8 +280,11 @@ def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
     d = B.shape[1]
 
     def shard_fn(B, y, valid, key):
-        onehot = jax.nn.one_hot(y, num_classes, dtype=jnp.float32)
-        base_stats = onehot * valid[:, None]
+        # Per-class weights TRANSPOSED to (C, n): the long row axis must
+        # sit in TPU lanes (an (n, C<128) layout pays for 128 lanes).
+        classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
+        base_stats = ((y[None, :] == classes).astype(jnp.float32)
+                      * valid[None, :])
 
         def one_tree(key):
             kb, kf = jax.random.split(key)
@@ -226,7 +297,7 @@ def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
                 kb = jax.random.fold_in(kb, jax.lax.axis_index(DATA_AXIS))
                 w = jax.random.poisson(kb, 1.0, (B.shape[0],)).astype(
                     jnp.float32)
-                stats = base_stats * w[:, None]
+                stats = base_stats * w[None, :]
                 # mtry features allowed per tree (same mask on all shards).
                 perm = jax.random.permutation(kf, d)
                 allowed = jnp.zeros((d,), bool).at[perm[:mtry]].set(True)
@@ -249,6 +320,8 @@ def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
 
 def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
                    max_depth, n_bins, mtry=None):
+    if n_bins > 256:
+        raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
     X = np.asarray(X, np.float32)
     edges = quantile_edges(X, n_bins)
     B_host = np.asarray(bin_features(jnp.asarray(X), jnp.asarray(edges)))
@@ -320,7 +393,7 @@ def _fit_gbt(B, y, valid, *, max_depth, n_bins, n_rounds, mesh,
             p = jax.nn.sigmoid(margin)
             g = (p - yf) * valid          # d loss / d margin
             h = jnp.maximum(p * (1 - p), 1e-6) * valid
-            stats = jnp.stack([g, h], axis=1)
+            stats = jnp.stack([g, h], axis=0)          # (2, n) — lanes = n
             feat, thr, internal, leaf = _build_tree(
                 B, stats, jnp.zeros((B.shape[1],), jnp.float32),
                 max_depth=max_depth, n_bins=n_bins, gain_fn=gain_fn,
@@ -362,6 +435,8 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
         # Parity with Spark 2.4: GBTClassifier supports binary only.
         raise ValueError("gb supports binary classification only "
                          "(as the reference's GBTClassifier)")
+    if n_bins > 256:
+        raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
     X = np.asarray(X, np.float32)
     edges = quantile_edges(X, n_bins)
     B_host = np.asarray(bin_features(jnp.asarray(X), jnp.asarray(edges)))

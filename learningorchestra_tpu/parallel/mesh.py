@@ -22,6 +22,7 @@ pod slice.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Optional, Tuple
 
 import jax
@@ -108,12 +109,24 @@ class MeshRuntime:
     The reference builds one SparkSession per request and tears it down
     (model_builder.py:70-95,177); devices are persistent here, so the mesh is
     built once and shared by every job in the server process.
+
+    ``shard_rows`` memoizes host→device transfers per host array: a
+    5-classifier build shards the same design matrix five times (and PCIe —
+    or worse, a tunneled TPU link — makes each gigabyte-scale transfer the
+    dominant cost), so the sharded device array is cached keyed by the host
+    array's identity and dropped when the host array is garbage-collected.
+    Callers must treat arrays handed to ``shard_rows`` as immutable — the
+    catalog's column snapshots and the builder's per-build design matrices
+    already are.
     """
 
     def __init__(self, cfg: Optional[Settings] = None):
         self.cfg = cfg or global_settings
-        self._lock = threading.Lock()
+        # RLock: cache-eviction finalizers can fire from gc inside a
+        # lock-holding allocation; a plain Lock would self-deadlock.
+        self._lock = threading.RLock()
         self._mesh: Optional[Mesh] = None
+        self._transfer_cache: dict = {}
 
     @property
     def mesh(self) -> Mesh:
@@ -123,7 +136,25 @@ class MeshRuntime:
             return self._mesh
 
     def shard_rows(self, arr: np.ndarray) -> Tuple[jax.Array, int]:
-        return shard_rows(self.mesh, arr)
+        if not isinstance(arr, np.ndarray):
+            return shard_rows(self.mesh, arr)
+        key = (id(arr), arr.shape, str(arr.dtype))
+        with self._lock:
+            hit = self._transfer_cache.get(key)
+        if hit is not None:
+            return hit
+        out = shard_rows(self.mesh, arr)
+        with self._lock:
+            self._transfer_cache[key] = out
+
+            def _evict(cache=self._transfer_cache, key=key, lock=self._lock):
+                with lock:
+                    cache.pop(key, None)
+
+            # Drop the device copy when the host array dies (also guards
+            # against a recycled id() pointing at the stale entry).
+            weakref.finalize(arr, _evict)
+        return out
 
     def replicate(self, x) -> jax.Array:
         return replicate(self.mesh, x)

@@ -201,3 +201,23 @@ def test_set_column_atomic_length_check():
     ds = Dataset(Metadata(name="s"), {"a": np.arange(4)})
     with pytest.raises(ValueError, match="column length"):
         ds.set_column("a", np.arange(3))
+
+
+def test_restart_marks_interrupted_jobs_failed(cfg):
+    """A dataset persisted metadata-first whose job died must come back
+    finished+error after recovery — terminal state across restarts."""
+    from learningorchestra_tpu.catalog.store import DatasetStore
+
+    cfg.persist = True
+    st = DatasetStore(cfg)
+    st.create("inflight", url="http://x/y.csv")       # never finished
+    st.create("done", columns={"a": np.arange(3)}, finished=True)
+    st.save("done")
+
+    st2 = DatasetStore(cfg)
+    loaded = st2.load_all()
+    assert set(loaded) == {"inflight", "done"}
+    meta = st2.get("inflight").metadata
+    assert meta.finished and "interrupted" in meta.error
+    assert st2.get("done").metadata.finished
+    assert st2.get("done").metadata.error is None

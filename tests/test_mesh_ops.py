@@ -122,3 +122,20 @@ def test_dtype_conversion_errors(store):
         convert_fields(store, "d", {"s": "banana"})
     with pytest.raises(ValueError, match="not convertible"):
         convert_fields(store, "d", {"s": "number"})
+
+
+def test_shard_rows_transfer_cache(runtime):
+    """Same host array → same device array (one transfer); new or dead
+    arrays → fresh transfers."""
+    x = np.arange(24, dtype=np.float32).reshape(24, 1)
+    a1, n1 = runtime.shard_rows(x)
+    a2, n2 = runtime.shard_rows(x)
+    assert a1 is a2 and n1 == n2 == 24
+    y = x.copy()
+    b1, _ = runtime.shard_rows(y)
+    assert b1 is not a1
+    key_count = len(runtime._transfer_cache)
+    del x, y
+    import gc
+    gc.collect()
+    assert len(runtime._transfer_cache) < key_count + 1  # entries evicted
