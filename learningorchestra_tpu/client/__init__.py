@@ -32,16 +32,56 @@ class JobFailed(RuntimeError):
 
 
 class Context:
-    """Connection context shared by the service clients."""
+    """Connection context shared by the service clients.
+
+    ``timeout`` bounds job polling (and the synchronous model build, which
+    legitimately runs for the whole fit); ``request_timeout`` bounds every
+    other HTTP call so a hung server can never hang the client forever.
+    Connection errors on idempotent calls (GET/DELETE) retry with
+    exponential backoff; POSTs never auto-retry (a retried create whose
+    first attempt actually landed would surface as a spurious 409).
+    """
 
     def __init__(self, base_url: str, poll_seconds: float =
-                 DEFAULT_POLL_SECONDS, timeout: float = 600.0):
+                 DEFAULT_POLL_SECONDS, timeout: float = 600.0,
+                 request_timeout: float = 30.0, retries: int = 3,
+                 backoff_seconds: float = 0.5):
         self.base_url = base_url.rstrip("/")
         self.poll_seconds = poll_seconds
         self.timeout = timeout
+        self.request_timeout = request_timeout
+        self.retries = retries
+        self.backoff_seconds = backoff_seconds
 
     def url(self, path: str) -> str:
         return f"{self.base_url}{path}"
+
+    def request(self, method: str, path: str,
+                timeout: Optional[float] = None, **kwargs):
+        deadline = timeout if timeout is not None else self.request_timeout
+        retries = self.retries if method.upper() in ("GET", "DELETE") else 0
+        attempt = 0
+        while True:
+            try:
+                return requests.request(method, self.url(path),
+                                        timeout=deadline, **kwargs)
+            except requests.ConnectionError:
+                if attempt >= retries:
+                    raise
+                time.sleep(self.backoff_seconds * (2 ** attempt))
+                attempt += 1
+
+    def get(self, path: str, **kw):
+        return self.request("GET", path, **kw)
+
+    def post(self, path: str, **kw):
+        return self.request("POST", path, **kw)
+
+    def patch(self, path: str, **kw):
+        return self.request("PATCH", path, **kw)
+
+    def delete(self, path: str, **kw):
+        return self.request("DELETE", path, **kw)
 
 
 class ResponseTreat:
@@ -74,9 +114,8 @@ class AsyncronousWait:
         """
         deadline = time.time() + self.context.timeout
         while True:
-            resp = requests.get(
-                self.context.url(f"/files/{dataset_name}"),
-                params={"limit": 1})
+            resp = self.context.get(f"/files/{dataset_name}",
+                                    params={"limit": 1})
             if resp.status_code == 404:
                 if not tolerate_missing:
                     raise KeyError(f"dataset not found: {dataset_name}")
@@ -109,8 +148,8 @@ class DatabaseApi(_ServiceClient):
 
     def create_file(self, filename: str, url: str,
                     wait: bool = False) -> Dict:
-        resp = requests.post(self.context.url("/files"),
-                             json={"filename": filename, "url": url})
+        resp = self.context.post("/files",
+                                 json={"filename": filename, "url": url})
         out = ResponseTreat.treatment(resp)
         if wait:
             self.waiter.wait(filename)
@@ -121,16 +160,15 @@ class DatabaseApi(_ServiceClient):
         params = {"skip": skip, "limit": limit}
         if query:
             params["query"] = json.dumps(query)
-        return ResponseTreat.treatment(requests.get(
-            self.context.url(f"/files/{filename}"), params=params))
+        return ResponseTreat.treatment(
+            self.context.get(f"/files/{filename}", params=params))
 
     def read_files_descriptor(self) -> List[Dict]:
-        return ResponseTreat.treatment(
-            requests.get(self.context.url("/files")))
+        return ResponseTreat.treatment(self.context.get("/files"))
 
     def delete_file(self, filename: str) -> Dict:
         return ResponseTreat.treatment(
-            requests.delete(self.context.url(f"/files/{filename}")))
+            self.context.delete(f"/files/{filename}"))
 
 
 class Projection(_ServiceClient):
@@ -141,8 +179,8 @@ class Projection(_ServiceClient):
                           fields: Sequence[str],
                           wait: bool = True) -> Dict:
         self.waiter.wait(parent_filename)
-        resp = requests.post(
-            self.context.url(f"/projections/{parent_filename}"),
+        resp = self.context.post(
+            f"/projections/{parent_filename}",
             json={"projection_filename": projection_filename,
                   "fields": list(fields)})
         out = ResponseTreat.treatment(resp)
@@ -158,8 +196,8 @@ class Histogram(_ServiceClient):
                          histogram_filename: str, fields: Sequence[str],
                          wait: bool = True) -> Dict:
         self.waiter.wait(parent_filename)
-        resp = requests.post(
-            self.context.url(f"/histograms/{parent_filename}"),
+        resp = self.context.post(
+            f"/histograms/{parent_filename}",
             json={"histogram_filename": histogram_filename,
                   "fields": list(fields)})
         out = ResponseTreat.treatment(resp)
@@ -174,8 +212,8 @@ class DataTypeHandler(_ServiceClient):
     def change_file_type(self, filename: str,
                          fields_dict: Dict[str, str]) -> Dict:
         self.waiter.wait(filename)
-        return ResponseTreat.treatment(requests.patch(
-            self.context.url(f"/fieldtypes/{filename}"), json=fields_dict))
+        return ResponseTreat.treatment(self.context.patch(
+            f"/fieldtypes/{filename}", json=fields_dict))
 
 
 class _ImageClient(_ServiceClient):
@@ -188,28 +226,26 @@ class _ImageClient(_ServiceClient):
         body = {"image_name": image_name, **kwargs}
         if label_name:
             body["label_name"] = label_name
-        resp = requests.post(
-            self.context.url(f"/{self.method}/images/{parent_filename}"),
-            json=body)
+        resp = self.context.post(
+            f"/{self.method}/images/{parent_filename}", json=body)
         out = ResponseTreat.treatment(resp)
         if wait and "poll" in out:
             self.waiter.wait(out["poll"])
         return out
 
     def read_image_plot(self, image_name: str) -> bytes:
-        resp = requests.get(
-            self.context.url(f"/{self.method}/images/{image_name}"))
+        resp = self.context.get(f"/{self.method}/images/{image_name}")
         if resp.status_code >= 400:
             raise RuntimeError(f"HTTP {resp.status_code}")
         return resp.content
 
     def read_image_plots(self) -> List[str]:
-        return ResponseTreat.treatment(requests.get(
-            self.context.url(f"/{self.method}/images")))
+        return ResponseTreat.treatment(
+            self.context.get(f"/{self.method}/images"))
 
     def delete_image_plot(self, image_name: str) -> Dict:
-        return ResponseTreat.treatment(requests.delete(
-            self.context.url(f"/{self.method}/images/{image_name}")))
+        return ResponseTreat.treatment(
+            self.context.delete(f"/{self.method}/images/{image_name}"))
 
 
 class Tsne(_ImageClient):
@@ -222,6 +258,20 @@ class Pca(_ImageClient):
     """PCA image service (reference __init__.py:243-308)."""
 
     method = "pca"
+
+
+class Observability(_ServiceClient):
+    """Server-side job and metrics introspection (upgrade over the
+    reference, which exposed only Spark's web UIs — SURVEY.md §5)."""
+
+    def jobs(self) -> List[Dict]:
+        return ResponseTreat.treatment(self.context.get("/jobs"))
+
+    def metrics(self) -> Dict:
+        return ResponseTreat.treatment(self.context.get("/metrics"))
+
+    def cluster(self) -> Dict:
+        return ResponseTreat.treatment(self.context.get("/cluster"))
 
 
 class Model(_ServiceClient):
@@ -250,8 +300,9 @@ class Model(_ServiceClient):
             body["preprocessor_code"] = preprocessor_code
         if hparams:
             body["hparams"] = hparams
-        out = ResponseTreat.treatment(requests.post(
-            self.context.url("/models"), json=body))
+        out = ResponseTreat.treatment(self.context.post(
+            "/models", json=body,
+            timeout=self.context.timeout if sync else None))
         if not sync:
             for c in classificators_list:
                 self.waiter.wait(f"{prediction_filename}_{c}",
@@ -261,19 +312,22 @@ class Model(_ServiceClient):
     # -- persisted-model registry (upgrade: reference discards models) ------
 
     def list_trained_models(self) -> List[Dict]:
-        return ResponseTreat.treatment(
-            requests.get(self.context.url("/trained-models")))
+        return ResponseTreat.treatment(self.context.get("/trained-models"))
 
     def predict(self, model_name: str, dataset_name: str,
-                prediction_filename: str) -> Dict:
+                prediction_filename: str, wait: bool = True) -> Dict:
         """Apply a persisted model (``<prediction>_<classifier>`` from a
-        previous create_model) to any stored dataset."""
+        previous create_model) to any stored dataset. The server runs the
+        predict as an async job; ``wait`` polls the output dataset."""
         self.waiter.wait(dataset_name)
-        return ResponseTreat.treatment(requests.post(
-            self.context.url(f"/trained-models/{model_name}/predictions"),
+        out = ResponseTreat.treatment(self.context.post(
+            f"/trained-models/{model_name}/predictions",
             json={"dataset_name": dataset_name,
                   "prediction_filename": prediction_filename}))
+        if wait:
+            self.waiter.wait(prediction_filename)
+        return out
 
     def delete_trained_model(self, model_name: str) -> Dict:
-        return ResponseTreat.treatment(requests.delete(
-            self.context.url(f"/trained-models/{model_name}")))
+        return ResponseTreat.treatment(
+            self.context.delete(f"/trained-models/{model_name}"))

@@ -123,9 +123,16 @@ class ModelBuilder:
                 report.metrics = classification_metrics(
                     y_test, preds, num_classes)
             if self.cfg.persist_models:
-                self.registry.save(f"{prediction_name}_{c}", model,
-                                   metrics=report.metrics,
-                                   preprocess=pp_meta)
+                # Best-effort: a persistence failure must not discard an
+                # otherwise successful fit's predictions; surface it in the
+                # persisted metrics instead.
+                try:
+                    self.registry.save(f"{prediction_name}_{c}", model,
+                                       metrics=report.metrics,
+                                       preprocess=pp_meta)
+                except Exception as exc:  # noqa: BLE001 — isolation boundary
+                    report.metrics["persist_error"] = (
+                        f"{type(exc).__name__}: {exc}")
             self._save_predictions(f"{prediction_name}_{c}", test_ds,
                                    preds, probs, report)
             return report
@@ -147,11 +154,16 @@ class ModelBuilder:
                                              metrics={"error": str(exc)}))
         return reports
 
-    def predict(self, model_name: str, dataset: str, out_name: str) -> None:
+    def predict(self, model_name: str, dataset: str, out_name: str,
+                existing: bool = False) -> None:
         """Serve a persisted model on a stored dataset: apply its train-time
         preprocessing state, predict, and write a prediction dataset — the
         re-use path the reference lacks entirely (models were discarded,
-        reference model_builder.py:227-248)."""
+        reference model_builder.py:227-248).
+
+        ``existing=True``: the caller (the async route) already created the
+        output dataset metadata-first, so a crash mid-predict is pollable.
+        """
         man, model = self.registry.load(model_name)
         pp = man.get("preprocess")
         if pp is None:
@@ -159,14 +171,15 @@ class ModelBuilder:
                 f"model {model_name} was exec-preprocessed; it carries no "
                 "reproducible preprocessing state to apply to new datasets")
         ds = self.store.get(dataset)
+        if not existing:
+            self.store.create(out_name, parent=dataset,
+                              extra={"model": model_name, "kind": man["kind"]})
         with timed("model_predict"), device_trace(self.cfg):
             X, _, _, _ = preprocess.design_matrix(
                 ds, pp["label"], pp["steps"], state=pp["state"],
                 feature_fields=pp["feature_fields"])
             probs = model.predict_proba(self.runtime, X)
         preds = np.argmax(probs, axis=1)
-        self.store.create(out_name, parent=dataset,
-                          extra={"model": model_name, "kind": man["kind"]})
         self._save_predictions(out_name, ds, preds, probs,
                                FitReport(kind=man["kind"], fit_time=0.0))
 

@@ -30,18 +30,24 @@ _VAR_FLOOR = 1e-6
 def _fit(X, y, n_valid, *, num_classes, smoothing):
     n, d = X.shape
     mask = (jnp.arange(n) < n_valid).astype(jnp.float32)
+    # Center features by their global mean before the moment matmuls:
+    # E[x²]−E[x]² cancels catastrophically in float32 for unstandardized
+    # large-magnitude features; on centered data both moments are O(var).
+    total = jnp.maximum(mask.sum(), 1.0)
+    center = (mask @ X) / total                      # (d,) global feature mean
+    Xc = X - center[None, :]
     # One-hot built transposed (C, n) — the long row axis sits in lanes;
     # an (n, C<128) layout would lane-pad to 128 columns (GBs at 11M rows).
     classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
     onehot_T = (y[None, :] == classes).astype(jnp.float32) * mask[None, :]
     counts = onehot_T.sum(axis=1)                    # (C,)
-    sums = onehot_T @ X                              # (C, d) — MXU contraction
-    sqsums = onehot_T @ (X * X)                      # (C, d)
+    sums = onehot_T @ Xc                             # (C, d) — MXU contraction
+    sqsums = onehot_T @ (Xc * Xc)                    # (C, d)
     denom = jnp.maximum(counts, 1.0)[:, None]
-    mean = sums / denom
-    var = jnp.maximum(sqsums / denom - mean ** 2, _VAR_FLOOR) + smoothing
+    mean_c = sums / denom
+    var = jnp.maximum(sqsums / denom - mean_c ** 2, _VAR_FLOOR) + smoothing
     prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
-    return {"mean": mean, "var": var, "log_prior": prior}
+    return {"mean": mean_c + center[None, :], "var": var, "log_prior": prior}
 
 
 @jax.jit
@@ -51,10 +57,16 @@ def _predict_proba(params, X):
     # quadratic form: Σ_d (x−μ)²/v = x²·(1/v) − 2x·(μ/v) + Σ μ²/v.
     # Two (n,d)@(d,C) matmuls instead of an (n, C, d) broadcast tensor
     # (which would be gigabytes at HIGGS scale before lane padding).
+    # Shifting x and μ by the across-class mean is exact (the shift cancels
+    # inside (x−μ)²) and keeps x² small enough that the expanded form
+    # doesn't catastrophically cancel for large-magnitude raw features.
+    c = mean.mean(axis=0)                              # (d,)
+    Xc = X - c[None, :]
+    mu = mean - c[None, :]
     inv_v = (1.0 / var).T                              # (d, C)
-    mu_v = (mean / var).T                              # (d, C)
-    const = ((mean ** 2 / var) + jnp.log(2.0 * jnp.pi * var)).sum(axis=1)
-    quad = (X * X) @ inv_v - 2.0 * (X @ mu_v)          # (n, C)
+    mu_v = (mu / var).T                                # (d, C)
+    const = ((mu ** 2 / var) + jnp.log(2.0 * jnp.pi * var)).sum(axis=1)
+    quad = (Xc * Xc) @ inv_v - 2.0 * (Xc @ mu_v)       # (n, C)
     loglik = -0.5 * (quad + const[None, :])
     return jax.nn.softmax(loglik + log_prior[None], axis=-1)
 

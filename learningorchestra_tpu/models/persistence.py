@@ -39,7 +39,9 @@ class ModelRegistry:
 
     def __init__(self, cfg: Settings):
         self.cfg = cfg
-        self.root = os.path.join(cfg.store_root, "_models")
+        # abspath: orbax refuses relative checkpoint paths, and store_root
+        # may arrive relative via LO_TPU_STORE_ROOT.
+        self.root = os.path.abspath(os.path.join(cfg.store_root, "_models"))
         self._lock = threading.Lock()
 
     def _dir(self, name: str) -> str:

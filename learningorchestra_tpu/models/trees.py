@@ -64,15 +64,20 @@ def quantile_edges(X: np.ndarray, n_bins: int,
 
 @jax.jit
 def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
-    """float features → uint8 bin codes via per-feature searchsorted.
+    """float features → uint8 bin codes: code = #edges strictly below x.
+
+    One fused compare+sum over the (n, d, n_bins-1) broadcast instead of
+    per-feature ``searchsorted`` (which lowers to gather-heavy binary
+    search on TPU); the compare form is a single VPU pass, XLA fuses the
+    broadcast away, and — crucially — it has no cross-row op, so a
+    row-sharded ``X`` yields a row-sharded result with no resharding.
 
     uint8 keeps the resident bin matrix 4× smaller than int32 (and TPU
     lane padding makes (n, d<128) arrays pay for 128 lanes regardless, so
     narrow dtypes are the only lever); n_bins is capped at 256.
     """
-    codes = jax.vmap(lambda col, e: jnp.searchsorted(e, col),
-                     in_axes=(1, 0))(X, edges)
-    return codes.T.astype(jnp.uint8)  # (n, d)
+    return (X[:, :, None] > edges[None, :, :]).sum(
+        axis=-1, dtype=jnp.int32).astype(jnp.uint8)  # (n, d)
 
 
 # ---------------------------------------------------------------------------
@@ -85,10 +90,17 @@ def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
 #: lane padding inflates trailing small dims to 128 lanes (a (n·d, 2) f32
 #: scatter operand allocates 64× its logical size).
 _ROW_BLOCK = 1 << 18
+#: f32 elements allowed for the per-block (blk, d·n_bins) one-hot operand of
+#: the histogram contraction (~128 MB) — bounds transient HBM per block.
+_ONEHOT_BUDGET = 32 * 1024 * 1024
 
 
-def _block_shape(n):
-    blk = min(_ROW_BLOCK, n)
+def _block_shape(n, onehot_cols=0):
+    blk = _ROW_BLOCK
+    if onehot_cols:
+        cap = max(512, _ONEHOT_BUDGET // onehot_cols)
+        blk = min(blk, 1 << (cap.bit_length() - 1))
+    blk = min(blk, n)
     nbk = -(-n // blk)
     return blk, nbk, nbk * blk
 
@@ -112,7 +124,7 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
     n, d = B.shape
     S = stats_T.shape[0]
     M = 2 ** (max_depth + 1) - 1
-    blk, nbk, n_pad = _block_shape(n)
+    blk, nbk, n_pad = _block_shape(n, d * n_bins)
     if n_pad != n:
         B = jnp.pad(B, ((0, n_pad - n), (0, 0)))
         stats_T = jnp.pad(stats_T, ((0, 0), (0, n_pad - n)))
@@ -123,7 +135,7 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
     thr = jnp.zeros((M,), jnp.int32)
     is_internal = jnp.zeros((M,), bool)
     assign = jnp.zeros((n_pad,), jnp.int32)
-    bins_row = jnp.arange(n_bins, dtype=jnp.int32)[None, :]
+    bins_u8 = jnp.arange(n_bins, dtype=jnp.uint8)[None, None, :]
 
     for level in range(max_depth):
         offset = 2 ** level - 1
@@ -134,10 +146,14 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         relb = rel.reshape(nbk, blk)
         actb = active.reshape(nbk, blk)
 
-        # (node, feature, bin, stat) histogram as MATMULS, not scatters:
-        # TPU scatter-adds serialize, but A.T @ onehot(bins) is an MXU
-        # contraction. A packs node-masked per-row stats (blk, nl·S); one
-        # (nl·S, blk) @ (blk, n_bins) product per feature per block.
+        # (node, feature, bin, stat) histogram as ONE MXU contraction per
+        # block — not scatters (TPU scatter-adds serialize) and not a
+        # per-feature matmul loop (n_bins=32 lane-pads to 128, nl·S is
+        # sublane-starved, and the d-way unroll bloats compile time). The
+        # (feature, bin) one-hot packs into a single (blk, d·n_bins)
+        # operand so every feature rides the same matmul: A packs
+        # node-masked per-row stats (nl·S, blk); one
+        # (nl·S, blk) @ (blk, d·n_bins) product per block.
         def hist_block(hist, inp):
             Bblk, relblk, ablk, sblk = inp  # (blk,d) (blk,) (blk,) (S,blk)
             node_oh = ((relblk[:, None] == jnp.arange(n_level)[None, :])
@@ -145,18 +161,15 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
             A = (node_oh[:, :, None].astype(jnp.float32)
                  * sblk.T[:, None, :])                       # (blk, nl, S)
             At = A.reshape(blk, n_level * S).T               # (nl·S, blk)
-            Bi = Bblk.astype(jnp.int32)
-            per_f = [
-                At @ (Bi[:, f][:, None] == bins_row).astype(jnp.float32)
-                for f in range(d)]                           # (nl·S, n_bins)
-            return hist + jnp.stack(per_f, axis=0), None
+            oh = (Bblk[:, :, None] == bins_u8).astype(jnp.float32)
+            return hist + At @ oh.reshape(blk, d * n_bins), None
 
         hist, _ = jax.lax.scan(
-            hist_block, jnp.zeros((d, n_level * S, n_bins), jnp.float32),
+            hist_block, jnp.zeros((n_level * S, d * n_bins), jnp.float32),
             (Bb, relb, actb, stb))
         hist = jax.lax.psum(hist, DATA_AXIS)                     # ICI reduce
-        # (d, nl·S, bins) → (nl, d, bins, S)
-        hist = hist.reshape(d, n_level, S, n_bins).transpose(1, 0, 3, 2)
+        # (nl·S, d·nb) → (nl, d, bins, S)
+        hist = hist.reshape(n_level, S, d, n_bins).transpose(0, 2, 3, 1)
 
         left = jnp.cumsum(hist, axis=2)                          # ≤ bin t
         total = left[:, :, -1:, :]                               # (nl,d,1,S)
@@ -324,10 +337,14 @@ def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
     X = np.asarray(X, np.float32)
     edges = quantile_edges(X, n_bins)
-    B_host = np.asarray(bin_features(jnp.asarray(X), jnp.asarray(edges)))
-    B_dev, n = runtime.shard_rows(B_host)
+    # Shard the raw design matrix (one cached host→device transfer shared
+    # with every other family in a multi-classifier build) and bin ON
+    # DEVICE: binning is row-local, so the uint8 codes come out row-sharded
+    # with no host round-trip of the bin matrix.
+    X_dev, n = runtime.shard_rows(X)
+    B_dev = bin_features(X_dev, runtime.replicate(edges))
     y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
-    padded_len = len(B_host) + (-len(B_host)) % runtime.mesh.shape[DATA_AXIS]
+    padded_len = len(X) + (-len(X)) % runtime.mesh.shape[DATA_AXIS]
     valid_dev, _ = runtime.shard_rows(
         (np.arange(padded_len) < n).astype(np.float32))
     d = X.shape[1]
@@ -439,10 +456,12 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
     X = np.asarray(X, np.float32)
     edges = quantile_edges(X, n_bins)
-    B_host = np.asarray(bin_features(jnp.asarray(X), jnp.asarray(edges)))
-    B_dev, n = runtime.shard_rows(B_host)
+    # Same device-side binning as _fit_cls_trees: shard X (cached), bin
+    # row-locally on device, no host round-trip of the bin matrix.
+    X_dev, n = runtime.shard_rows(X)
+    B_dev = bin_features(X_dev, runtime.replicate(edges))
     y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
-    padded_len = len(B_host) + (-len(B_host)) % runtime.mesh.shape[DATA_AXIS]
+    padded_len = len(X) + (-len(X)) % runtime.mesh.shape[DATA_AXIS]
     valid_dev, _ = runtime.shard_rows(
         (np.arange(padded_len) < n).astype(np.float32))
     feat, thr, internal, leaf_val = _fit_gbt(

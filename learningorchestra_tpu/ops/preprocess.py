@@ -106,8 +106,22 @@ def apply_steps(columns: Dict[str, np.ndarray],
             stats = state.get(key)
             tgt = [f for f in cols if cols[f].dtype.kind in "if"]
             if stats is None:
-                stats = {f: (float(np.nanmean(cols[f])),
-                             float(np.nanstd(cols[f]) or 1.0)) for f in tgt}
+                stats = {}
+                for f in tgt:
+                    c = cols[f].astype(np.float64)
+                    finite = np.isfinite(c)
+                    if finite.any():
+                        mu = float(c[finite].mean())
+                        sd = float(c[finite].std())
+                    else:
+                        # All-NaN column: identity stats instead of NaN
+                        # stats, which would poison the whole design
+                        # matrix (NaN is truthy, so `nanstd(c) or 1.0`
+                        # kept the NaN — round-1 review finding).
+                        mu, sd = 0.0, 1.0
+                    if not np.isfinite(sd) or sd == 0.0:
+                        sd = 1.0
+                    stats[f] = (mu, sd)
             for f in tgt:
                 if f in stats:
                     mu, sd = stats[f]

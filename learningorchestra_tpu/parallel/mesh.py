@@ -115,9 +115,10 @@ class MeshRuntime:
     or worse, a tunneled TPU link — makes each gigabyte-scale transfer the
     dominant cost), so the sharded device array is cached keyed by the host
     array's identity and dropped when the host array is garbage-collected.
-    Callers must treat arrays handed to ``shard_rows`` as immutable — the
-    catalog's column snapshots and the builder's per-build design matrices
-    already are.
+    Callers must treat arrays handed to ``shard_rows`` as immutable; the
+    cache *enforces* this by marking cached owner-arrays read-only (a later
+    in-place write raises instead of silently computing on stale device
+    data). Views are sharded uncached.
     """
 
     def __init__(self, cfg: Optional[Settings] = None):
@@ -143,6 +144,15 @@ class MeshRuntime:
             hit = self._transfer_cache.get(key)
         if hit is not None:
             return hit
+        # Enforce the immutability contract instead of just documenting it:
+        # freeze the host array on first caching so an in-place mutation
+        # (which would silently serve stale device data) raises at the
+        # mutation site. Views never enter the cache — freezing a view
+        # leaves its base writable, so mutation through the base would
+        # still serve stale device data silently.
+        if arr.base is not None or not arr.flags.owndata:
+            return shard_rows(self.mesh, arr)
+        arr.flags.writeable = False
         out = shard_rows(self.mesh, arr)
         with self._lock:
             self._transfer_cache[key] = out

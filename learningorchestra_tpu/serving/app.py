@@ -213,10 +213,29 @@ class App:
             dataset, out = req.require("dataset_name", "prediction_filename")
             if app.store.exists(out):
                 raise DatasetExists(out)
-            app.builder.predict(name, dataset, out)
-            meta = app.store.read(out, limit=1)[0]
-            return 201, {"result": f"predictions written to {out}",
-                         "metadata": meta}
+            man = app.builder.registry.manifest(name)   # 404 when missing
+            if not app.store.exists(dataset):
+                raise DatasetNotFound(dataset)
+            if man.get("preprocess") is None:
+                # Keep the synchronous 406 contract: an exec-preprocessed
+                # model can never re-serve, so failing inside the job would
+                # just strand a doomed dataset under the requested name.
+                raise ValueError(
+                    f"model {name} was exec-preprocessed; it carries no "
+                    "reproducible preprocessing state to apply to new "
+                    "datasets")
+            # Metadata-first + async job, like every other compute route: a
+            # long predict must not block the HTTP worker, duplicate
+            # requests collide on the created dataset (409), and a crash
+            # mid-predict leaves a pollable failure record.
+            app.store.create(out, parent=dataset,
+                             extra={"model": name, "kind": man["kind"]})
+            app.jobs.submit(
+                "model_predict", out,
+                lambda: app.builder.predict(name, dataset, out,
+                                            existing=True))
+            return 201, {"result": f"prediction dataset {out} created",
+                         "prediction_filename": out}
 
         # ---- tsne / pca images (reference tsne_image/server.py:57-155)
         for method in ("tsne", "pca"):

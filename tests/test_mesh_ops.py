@@ -126,14 +126,25 @@ def test_dtype_conversion_errors(store):
 
 def test_shard_rows_transfer_cache(runtime):
     """Same host array → same device array (one transfer); new or dead
-    arrays → fresh transfers."""
-    x = np.arange(24, dtype=np.float32).reshape(24, 1)
+    arrays → fresh transfers. Cached owner-arrays are frozen (in-place
+    mutation raises instead of serving stale device data); views bypass
+    the cache entirely (freezing a view leaves its base writable)."""
+    x = np.arange(24, dtype=np.float32).reshape(24, 1).copy()
     a1, n1 = runtime.shard_rows(x)
     a2, n2 = runtime.shard_rows(x)
     assert a1 is a2 and n1 == n2 == 24
+    with np.testing.assert_raises(ValueError):   # frozen: contract enforced
+        x[0, 0] = 99.0
     y = x.copy()
     b1, _ = runtime.shard_rows(y)
     assert b1 is not a1
+    # views are sharded uncached — base mutation could not be detected
+    base = np.zeros((32, 2), np.float32)
+    v = base[:24]
+    c1, _ = runtime.shard_rows(v)
+    c2, _ = runtime.shard_rows(v)
+    assert c1 is not c2
+    assert base.flags.writeable            # base untouched by the cache
     key_count = len(runtime._transfer_cache)
     del x, y
     import gc

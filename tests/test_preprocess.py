@@ -1,0 +1,76 @@
+"""Preprocessing edge cases — NaN handling regression suite.
+
+Round-1 review: ``np.nanstd(all-NaN) or 1.0`` kept the NaN (NaN is truthy)
+and poisoned the whole design matrix; standardize-before-fillna silently
+propagated NaN. These tests pin the fixed behavior over mixed
+string/NaN/constant columns in every step order.
+"""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.ops.preprocess import apply_steps, design_matrix
+
+
+def _mixed_cols(n=40, all_nan=True, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "num": rng.normal(100.0, 5.0, n),
+        "holey": np.where(rng.random(n) < 0.3, np.nan, rng.normal(size=n)),
+        "const": np.full(n, 7.0),
+        "cat": np.array(rng.choice(["a", "b", None], n), dtype=object),
+        "int": rng.integers(0, 5, n).astype(np.int64),
+    }
+    if all_nan:
+        cols["void"] = np.full(n, np.nan)
+    return cols
+
+
+def test_standardize_all_nan_column_stays_finite_stats():
+    cols, state = apply_steps(_mixed_cols(), [{"op": "standardize"}])
+    # identity stats for the all-NaN column; every other stat finite
+    for f, (mu, sd) in state["0:standardize"].items():
+        assert np.isfinite(mu) and np.isfinite(sd) and sd != 0.0
+    # the holey/void columns still carry their NaNs (fillna's job), but
+    # fully-observed columns must come out standardized and finite
+    assert np.isfinite(cols["num"]).all()
+    assert abs(cols["num"].mean()) < 1e-9
+    assert np.isfinite(cols["const"]).all()      # sd=0 → identity scale
+
+
+def test_standardize_constant_column_no_divzero():
+    cols, state = apply_steps({"c": np.full(10, 3.5)},
+                              [{"op": "standardize"}])
+    assert np.isfinite(cols["c"]).all()
+    assert (cols["c"] == 0.0).all()
+
+
+@pytest.mark.parametrize("order", [
+    [{"op": "label_encode"}, {"op": "standardize"}, {"op": "fillna"}],
+    [{"op": "label_encode"}, {"op": "fillna"}, {"op": "standardize"}],
+])
+def test_design_matrix_finite_in_either_step_order(order):
+    """standardize→fillna and fillna→standardize must both yield a fully
+    finite design matrix, including all-NaN and constant columns."""
+    from learningorchestra_tpu.catalog.dataset import Dataset, Metadata
+
+    cols = _mixed_cols()
+    cols["y"] = (np.arange(40) % 2).astype(np.int64)
+    ds = Dataset(Metadata("t", fields=list(cols)), columns=cols)
+    X, y, fields, state = design_matrix(ds, "y", order)
+    assert np.isfinite(X).all(), f"NaN leaked through {order}"
+    assert y is not None and set(np.unique(y)) <= {0, 1}
+    # train-fitted state applies cleanly to a differently-distributed split
+    cols2 = _mixed_cols(seed=1)
+    cols2["y"] = (np.arange(40) % 2).astype(np.int64)
+    ds2 = Dataset(Metadata("t2", fields=list(cols2)), columns=cols2)
+    X2, _, _, _ = design_matrix(ds2, "y", order, state=state,
+                                feature_fields=fields)
+    assert np.isfinite(X2).all()
+    assert X2.shape[1] == X.shape[1]
+
+
+def test_fillna_all_nan_column_fills_zero():
+    cols, _ = apply_steps({"void": np.full(8, np.nan)},
+                          [{"op": "fillna", "strategy": "mean"}])
+    assert (cols["void"] == 0.0).all()

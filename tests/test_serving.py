@@ -233,8 +233,12 @@ def test_trained_model_registry_routes(served):
     names = [x["name"] for x in m.list_trained_models()]
     assert "tmr_lr" in names
 
+    # Async like every compute route: 201 immediately, then the client
+    # polls the metadata-first output dataset to completion.
     out = m.predict("tmr_lr", "tmr_train", "tmr_served")
-    assert out["metadata"]["finished"] is True
+    assert out["prediction_filename"] == "tmr_served"
+    meta = db.read_file("tmr_served", limit=1)[0]
+    assert meta["finished"] is True
     row = db.read_file("tmr_served", skip=1, limit=1)[0]
     assert row["prediction"] in (0, 1)
 
@@ -251,3 +255,56 @@ def test_trained_model_registry_routes(served):
     metrics = requests.get(ctx.url("/metrics")).json()
     assert metrics["ops"]["fit.lr"]["count"] >= 1
     assert metrics["jobs"].get("done", 0) >= 1
+
+
+def test_client_times_out_on_hung_server():
+    """A server that accepts connections but never responds must not hang
+    the client forever: every client call carries a request timeout
+    (round-1 review: requests.* were issued with no timeout=)."""
+    import socket
+    import threading
+
+    import requests
+
+    hung = socket.socket()
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(1)
+    port = hung.getsockname()[1]
+    conns = []
+    t = threading.Thread(
+        target=lambda: conns.append(hung.accept()), daemon=True)
+    t.start()
+    try:
+        ctx = Context(f"http://127.0.0.1:{port}", request_timeout=0.3,
+                      retries=0)
+        with pytest.raises(requests.Timeout):
+            DatabaseApi(ctx).read_files_descriptor()
+    finally:
+        hung.close()
+
+
+def test_client_retries_connection_errors():
+    """GETs retry with backoff on connection errors; POSTs never do (a
+    landed create would resurface as a spurious 409)."""
+    import requests
+
+    # nothing listens on this port: immediate connection refusal
+    dead = Context("http://127.0.0.1:1", retries=2, backoff_seconds=0.01)
+    calls = []
+    orig = requests.request
+
+    def counting(method, url, **kw):
+        calls.append(method)
+        return orig(method, url, **kw)
+
+    requests.request = counting
+    try:
+        with pytest.raises(requests.ConnectionError):
+            dead.get("/files")
+        assert len(calls) == 3          # initial + 2 retries
+        calls.clear()
+        with pytest.raises(requests.ConnectionError):
+            dead.post("/files", json={})
+        assert len(calls) == 1          # POST: no auto-retry
+    finally:
+        requests.request = orig
