@@ -3,10 +3,21 @@
 The reference's "lr" is ``pyspark.ml.classification.LogisticRegression``
 fitted as a distributed iterative Spark job (reference model_builder.py:152,
 200). TPU-native design: multinomial logistic regression as one jit-compiled
-program — a ``lax.scan`` over full-batch Adam steps on the standardized
-design matrix. Rows are sharded across the mesh data axis; the loss is a
-masked mean, so its gradient contracts over the sharded row dimension and
-XLA inserts the ICI all-reduce automatically (no hand-written collectives).
+program. Two solvers:
+
+- **Newton/IRLS** (default whenever ``C·(d+1)`` is small enough for the
+  Hessian solve): ~20 second-order steps instead of hundreds of
+  first-order ones. Each step is a ``lax.scan`` over row blocks that
+  accumulates the gradient and the exact multinomial Hessian with MXU
+  contractions — blocking matters because any (n, C<128)-shaped
+  intermediate lane-pads to 128 on TPU, so full-batch softmax/residual
+  tensors would each cost gigabytes of HBM traffic at 11M rows.
+- **Adam scan** (wide-model fallback): full-batch first-order steps on the
+  bf16 design matrix.
+
+Rows are sharded across the mesh data axis; losses/moments are masked
+means, so their contractions over the sharded row dimension make XLA
+insert the ICI all-reduce automatically (no hand-written collectives).
 bfloat16 matmuls feed the MXU; parameters stay float32.
 """
 
@@ -18,9 +29,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import PartitionSpec as P
 
 from learningorchestra_tpu.models.base import TrainedModel
-from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 
 
 def _logits(params, X):
@@ -78,6 +90,106 @@ def _predict_proba(params, X):
     return jax.nn.softmax(_logits(params, X), axis=-1)
 
 
+#: Rows per Newton accumulation block (bounds the lane-padded transient
+#: tensors: a (B, C·(d+1)) bf16 block at B=2^20, C·(d+1)=58 is ~120 MB).
+_NEWTON_BLOCK = 1 << 20
+#: Newton applies while the Hessian side C·(d+1) stays this small — the
+#: (C·(d+1))² solve is negligible and the per-block A tensor bounded.
+_NEWTON_MAX_CD = 256
+
+
+@partial(jax.jit, static_argnames=("num_classes", "iters", "mesh"))
+def _fit_newton(X, y, n_valid, mu, sigma, *, num_classes, iters, l2, mesh):
+    """Exact multinomial-Newton (IRLS) fit, row-blocked per shard.
+
+    Z = [standardized X | 1] in bf16; per step each data-axis shard scans
+    its row blocks accumulating g = Z'(P−Y) and the exact Hessian
+    H[(c,i),(c',j)] = Σ_n z_i z_j p_c (δ_cc' − p_c'), one ``psum`` reduces
+    both over ICI, and a replicated dense solve updates the (d+1, C)
+    augmented weights. Quadratic convergence: ~20 steps replace hundreds
+    of first-order passes over the data.
+    """
+    C = num_classes
+    d = X.shape[1]
+    d1 = d + 1
+    # l2 penalizes weights, not the intercept row (sklearn/Spark parity).
+    # The ε term regularizes the softmax shift-null direction of H; it must
+    # dominate the bf16 noise floor of the accumulated Hessian (~1e-3
+    # relative), else the solve blows up along the null space.
+    ridge = jnp.tile(jnp.concatenate(
+        [jnp.full((d,), 2.0 * l2), jnp.zeros((1,))]), C) + 1e-4
+
+    def shard_fn(X, y, n_valid, mu, sigma):
+        nloc = X.shape[0]
+        start = jax.lax.axis_index(DATA_AXIS) * nloc
+        mask = ((start + jnp.arange(nloc)) < n_valid).astype(jnp.float32)
+        Z = jnp.concatenate(
+            [((X - mu) / sigma), jnp.ones((nloc, 1), jnp.float32)],
+            axis=1).astype(jnp.bfloat16)                   # (nloc, d+1)
+        blk = min(_NEWTON_BLOCK, nloc)
+        nbk = -(-nloc // blk)
+        pad = nbk * blk - nloc
+        if pad:
+            Z = jnp.pad(Z, ((0, pad), (0, 0)))
+            y = jnp.pad(y, (0, pad))
+            mask = jnp.pad(mask, (0, pad))
+        Zb = Z.reshape(nbk, blk, d1)
+        yb = y.reshape(nbk, blk)
+        mb = mask.reshape(nbk, blk)
+        nf = jnp.maximum(n_valid.astype(jnp.float32), 1.0)
+
+        def step(Wz, _):
+            def acc_block(carry, inp):
+                g, T1, T2 = carry
+                Zblk, yblk, mblk = inp
+                logits = (Zblk @ Wz.astype(jnp.bfloat16)).astype(
+                    jnp.float32)
+                Pr = jax.nn.softmax(logits, axis=-1) * mblk[:, None]
+                Y1 = (jax.nn.one_hot(yblk, C, dtype=jnp.float32)
+                      * mblk[:, None])
+                R = (Pr - Y1).astype(jnp.bfloat16)
+                g = g + (Zblk.T @ R).astype(jnp.float32)      # (d1, C)
+                Pb = Pr.astype(jnp.bfloat16)
+                A = (Pb[:, :, None] * Zblk[:, None, :]).reshape(
+                    blk, C * d1)
+                T2 = T2 + (A.T @ A).astype(jnp.float32)       # (Cd1, Cd1)
+                T1 = T1 + jnp.stack([
+                    (Zblk.T @ (Zblk * Pb[:, c:c + 1])).astype(jnp.float32)
+                    for c in range(C)])                       # (C, d1, d1)
+                return (g, T1, T2), None
+
+            (g, T1, T2), _ = jax.lax.scan(
+                acc_block,
+                (jnp.zeros((d1, C), jnp.float32),
+                 jnp.zeros((C, d1, d1), jnp.float32),
+                 jnp.zeros((C * d1, C * d1), jnp.float32)),
+                (Zb, yb, mb))
+            g, T1, T2 = jax.lax.psum((g, T1, T2), DATA_AXIS)  # ICI reduce
+            gflat = g.T.reshape(C * d1) / nf + ridge * Wz.T.reshape(C * d1)
+            H = jax.scipy.linalg.block_diag(
+                *[T1[c] for c in range(C)]) - T2
+            H = H / nf + jnp.diag(ridge)
+            delta = jnp.linalg.solve(H, gflat)
+            # Trust region: on separable data the saturated Hessian
+            # vanishes and an uncapped Newton step overshoots to NaN.
+            # Near the optimum steps are tiny, so the cap never binds.
+            norm = jnp.linalg.norm(delta)
+            delta = delta * jnp.minimum(1.0, 5.0 / jnp.maximum(norm, 1e-12))
+            delta = jnp.where(jnp.isfinite(delta), delta, 0.0)
+            return Wz - delta.reshape(C, d1).T, None
+
+        Wz, _ = jax.lax.scan(step, jnp.zeros((d1, C), jnp.float32), None,
+                             length=iters)
+        return Wz
+
+    Wz = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+        out_specs=P(), check_vma=False,
+    )(X, y, n_valid, mu, sigma)
+    return {"W": Wz[:d], "b": Wz[d], "mu": mu, "sigma": sigma}
+
+
 def _standardization_stats(X: np.ndarray):
     mu = X.mean(axis=0)
     sigma = X.std(axis=0)
@@ -87,16 +199,31 @@ def _standardization_stats(X: np.ndarray):
 
 def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
         num_classes: int, seed: int = 0, *, iters: int = 300,
-        lr: float = 0.1, l2: float = 1e-4) -> TrainedModel:
+        lr: float = 0.1, l2: float = 1e-4,
+        solver: str = "auto") -> TrainedModel:
     X = np.asarray(X, np.float32)
     mu, sigma = _standardization_stats(X)
     X_dev, n = runtime.shard_rows(X)
     y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
-    params, _ = _fit(X_dev, y_dev, runtime.replicate(np.int32(n)),
-                     runtime.replicate(mu), runtime.replicate(sigma),
-                     num_classes=num_classes, iters=iters, lr=lr, l2=l2,
-                     seed=seed)
+    if solver == "auto":
+        solver = ("newton"
+                  if num_classes * (X.shape[1] + 1) <= _NEWTON_MAX_CD
+                  else "adam")
+    if solver == "newton":
+        params = _fit_newton(
+            X_dev, y_dev, runtime.replicate(np.int32(n)),
+            runtime.replicate(mu), runtime.replicate(sigma),
+            num_classes=num_classes, iters=min(iters, 20), l2=l2,
+            mesh=runtime.mesh)
+    elif solver == "adam":
+        params, _ = _fit(X_dev, y_dev, runtime.replicate(np.int32(n)),
+                         runtime.replicate(mu), runtime.replicate(sigma),
+                         num_classes=num_classes, iters=iters, lr=lr, l2=l2,
+                         seed=seed)
+    else:
+        raise ValueError(f"unknown lr solver {solver!r}")
     return TrainedModel(kind="lr", params=params,
                         predict_proba_fn=_predict_proba,
                         num_classes=num_classes,
-                        hparams={"iters": iters, "lr": lr, "l2": l2})
+                        hparams={"iters": iters, "lr": lr, "l2": l2,
+                                 "solver": solver})

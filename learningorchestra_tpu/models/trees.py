@@ -131,49 +131,56 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
     Bb = B.reshape(nbk, blk, d)
     stb = stats_T.reshape(S, nbk, blk).transpose(1, 0, 2)   # (nbk, S, blk)
 
-    feat = jnp.zeros((M,), jnp.int32)
-    thr = jnp.zeros((M,), jnp.int32)
-    is_internal = jnp.zeros((M,), bool)
-    assign = jnp.zeros((n_pad,), jnp.int32)
     bins_u8 = jnp.arange(n_bins, dtype=jnp.uint8)[None, None, :]
+    #: Fixed per-level node width: the deepest processed level has
+    #: 2^(max_depth-1) nodes, and every level runs at that width so the
+    #: whole level loop is ONE lax.scan body (a per-level Python unroll
+    #: re-traces 5 distinct level shapes and blew gb's compile time to
+    #: minutes). Slots past a level's real node count carry all-zero
+    #: stats — their gain is NEG so they never split — and their
+    #: node-id writes spill into exactly the id range later levels
+    #: rewrite (binary-heap layout: level l writes [2^l-1, 2^l-1+NL),
+    #: and every id ≥ 2^(l+1)-1 is level-(l+1)+ territory).
+    NL = 2 ** max(max_depth - 1, 0)
 
-    for level in range(max_depth):
-        offset = 2 ** level - 1
-        n_level = 2 ** level
+    def level_step(carry, l):
+        feat, thr, is_internal, assign = carry
+        offset = jnp.left_shift(1, l) - 1            # 2^l - 1
+        nl = offset + 1                              # 2^l real nodes
         rel = assign - offset
-        active = (rel >= 0) & (rel < n_level)
+        active = (rel >= 0) & (rel < nl)
         rel = jnp.where(active, rel, 0)
         relb = rel.reshape(nbk, blk)
         actb = active.reshape(nbk, blk)
 
         # (node, feature, bin, stat) histogram as ONE MXU contraction per
         # block — not scatters (TPU scatter-adds serialize) and not a
-        # per-feature matmul loop (n_bins=32 lane-pads to 128, nl·S is
+        # per-feature matmul loop (n_bins=32 lane-pads to 128, NL·S is
         # sublane-starved, and the d-way unroll bloats compile time). The
         # (feature, bin) one-hot packs into a single (blk, d·n_bins)
         # operand so every feature rides the same matmul: A packs
-        # node-masked per-row stats (nl·S, blk); one
-        # (nl·S, blk) @ (blk, d·n_bins) product per block.
+        # node-masked per-row stats (NL·S, blk); one
+        # (NL·S, blk) @ (blk, d·n_bins) product per block.
         def hist_block(hist, inp):
             Bblk, relblk, ablk, sblk = inp  # (blk,d) (blk,) (blk,) (S,blk)
-            node_oh = ((relblk[:, None] == jnp.arange(n_level)[None, :])
-                       & ablk[:, None])                      # (blk, nl)
+            node_oh = ((relblk[:, None] == jnp.arange(NL)[None, :])
+                       & ablk[:, None])                      # (blk, NL)
             A = (node_oh[:, :, None].astype(jnp.float32)
-                 * sblk.T[:, None, :])                       # (blk, nl, S)
-            At = A.reshape(blk, n_level * S).T               # (nl·S, blk)
+                 * sblk.T[:, None, :])                       # (blk, NL, S)
+            At = A.reshape(blk, NL * S).T                    # (NL·S, blk)
             oh = (Bblk[:, :, None] == bins_u8).astype(jnp.float32)
             return hist + At @ oh.reshape(blk, d * n_bins), None
 
         hist, _ = jax.lax.scan(
-            hist_block, jnp.zeros((n_level * S, d * n_bins), jnp.float32),
+            hist_block, jnp.zeros((NL * S, d * n_bins), jnp.float32),
             (Bb, relb, actb, stb))
         hist = jax.lax.psum(hist, DATA_AXIS)                     # ICI reduce
-        # (nl·S, d·nb) → (nl, d, bins, S)
-        hist = hist.reshape(n_level, S, d, n_bins).transpose(0, 2, 3, 1)
+        # (NL·S, d·nb) → (NL, d, bins, S)
+        hist = hist.reshape(NL, S, d, n_bins).transpose(0, 2, 3, 1)
 
         left = jnp.cumsum(hist, axis=2)                          # ≤ bin t
-        total = left[:, :, -1:, :]                               # (nl,d,1,S)
-        gain = gain_fn(left, total)                              # (nl,d,nb)
+        total = left[:, :, -1:, :]                               # (NL,d,1,S)
+        gain = gain_fn(left, total)                              # (NL,d,nb)
         # A split at the last bin sends everything left — forbid it.
         gain = gain.at[:, :, -1].set(NEG)
         lw = weight_fn(left)
@@ -181,14 +188,14 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         ok = (lw >= min_child_weight) & (rw >= min_child_weight)
         gain = jnp.where(ok, gain, NEG) + feat_gain_mask[None, :, None]
 
-        flat = gain.reshape(n_level, d * n_bins)
+        flat = gain.reshape(NL, d * n_bins)
         best = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
         best_f = (best // n_bins).astype(jnp.int32)
         best_t = (best % n_bins).astype(jnp.int32)
         split = best_gain > min_gain
 
-        node_ids = offset + jnp.arange(n_level)
+        node_ids = offset + jnp.arange(NL)
         feat = feat.at[node_ids].set(jnp.where(split, best_f, 0))
         thr = thr.at[node_ids].set(jnp.where(split, best_t, 0))
         is_internal = is_internal.at[node_ids].set(split)
@@ -207,7 +214,13 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
 
         _, asg = jax.lax.scan(route_block, None,
                               (Bb, relb, actb, assign.reshape(nbk, blk)))
-        assign = asg.reshape(n_pad)
+        return (feat, thr, is_internal, asg.reshape(n_pad)), None
+
+    (feat, thr, is_internal, assign), _ = jax.lax.scan(
+        level_step,
+        (jnp.zeros((M,), jnp.int32), jnp.zeros((M,), jnp.int32),
+         jnp.zeros((M,), bool), jnp.zeros((n_pad,), jnp.int32)),
+        jnp.arange(max_depth))
 
     # Leaf sufficient statistics over ALL nodes (every row sits at a leaf;
     # padded columns carry zero stats) — the same matmul-histogram trick.
