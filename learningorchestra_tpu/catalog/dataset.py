@@ -13,6 +13,17 @@ semantics, row ``_id`` numbering — over a TPU-friendly *mechanism*: columns
 are contiguous numpy arrays (zero-copy into ``jax.numpy``/device shards)
 instead of per-row BSON documents.
 
+Out-of-core: the reference's data plane is disk-backed Mongo and handles
+collections larger than RAM (reference database.py:133-216). Here each
+append becomes an immutable *chunk* that can live in host RAM, in a parquet
+chunk file on disk, or both. Under a configured RAM budget
+(``Settings.ram_budget_mb``) chunks are flushed to disk and evicted, and
+streaming consumers (`iter_chunks`) process the dataset one chunk at a time
+— ingest → histogram → projection run on datasets larger than host memory.
+Chunk files are written via tmp+rename and recorded in an fsynced
+``journal.jsonl``, making every chunk commit O(chunk) and crash-consistent
+(a recovered dataset is always a journaled prefix of the appends).
+
 Upgrade over the reference: a mid-flight crash in the reference leaves
 ``finished: false`` forever and clients poll infinitely (SURVEY.md §5); here
 metadata carries an ``error`` field that job runners set on failure so
@@ -21,10 +32,12 @@ clients can fail fast.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -82,6 +95,85 @@ class Metadata:
         )
 
 
+def _arr_bytes(a: np.ndarray) -> int:
+    if a.dtype == object:
+        # Estimate: pointer + small-string payload per element. Exact
+        # accounting would walk every object; the budget is a soft bound.
+        return len(a) * 64
+    return int(a.nbytes)
+
+
+class _Chunk:
+    """One appended block of rows; in host RAM, in a parquet file, or both.
+
+    ``cols`` drops to ``None`` when the chunk is evicted under a RAM budget;
+    ``path`` is set once the chunk is durably flushed. Chunk files are
+    immutable (written tmp+rename, never modified), so a disk-backed chunk
+    can be re-read without coordination: readers snapshot ``cols`` into a
+    local before testing it, and fall back to the file.
+    """
+
+    __slots__ = ("cols", "path", "n_rows", "dtypes", "data_bytes",
+                 "_evictable")
+
+    def __init__(self, cols: Columns):
+        self.cols: Optional[Columns] = cols
+        self.path: Optional[str] = None
+        self.n_rows = len(next(iter(cols.values())))
+        self.dtypes: Dict[str, np.dtype] = {f: a.dtype
+                                            for f, a in cols.items()}
+        self.data_bytes = sum(_arr_bytes(a) for a in cols.values())
+        self._evictable: Optional[bool] = None
+
+    @classmethod
+    def on_disk(cls, path: str, n_rows: int, dtypes: Dict[str, np.dtype],
+                data_bytes: int) -> "_Chunk":
+        """Handle for a journaled chunk file — no data read (lazy load)."""
+        c = cls.__new__(cls)
+        c.cols = None
+        c.path = path
+        c.n_rows = n_rows
+        c.dtypes = dict(dtypes)
+        c.data_bytes = data_bytes
+        c._evictable = True
+        return c
+
+    @property
+    def in_memory(self) -> bool:
+        return self.cols is not None
+
+    @property
+    def evictable(self) -> bool:
+        """Whether a disk round-trip reproduces this chunk's values exactly.
+
+        Parquet stores object columns as nullable strings, so a chunk whose
+        object columns hold anything but str/None (e.g. float scores with
+        None gaps from ``append_rows``) would come back with its numbers
+        silently stringified — such chunks stay resident instead of
+        evicting. (Cross-restart persistence still stringifies them; the
+        guarantee here is no value drift *within* a process.)"""
+        if self._evictable is None:
+            cols = self.cols
+            ok = True
+            if cols is not None:
+                for a in cols.values():
+                    if a.dtype == object and not is_stringy(a):
+                        ok = False
+                        break
+            self._evictable = ok
+        return self._evictable
+
+    def materialize(self, fields: Optional[List[str]] = None) -> Columns:
+        """Column data for this chunk (optionally a field subset). Disk
+        reads are NOT cached back — streaming consumers stay bounded."""
+        cols = self.cols
+        if cols is None:
+            return read_chunk_parquet(self.path, fields)
+        if fields is not None:
+            return {f: cols[f] for f in fields}
+        return cols
+
+
 class Dataset:
     """A named columnar dataset with reference-compatible row addressing.
 
@@ -95,10 +187,53 @@ class Dataset:
         # Guards _chunks/_consolidated: ingestion appends from a job thread
         # while readers poll/consolidate the same dataset.
         self._data_lock = threading.Lock()
-        self._chunks: List[Columns] = []
+        self._chunks: List[_Chunk] = []
         self._consolidated: Optional[Columns] = None
+        self._chunk_dir: Optional[str] = None
+        self._journal_path: Optional[str] = None
+        self._ram_budget: Optional[int] = None
+        #: Chunk files are named ``GGG-NNNNN.parquet``: the generation bumps
+        #: on every rewrite (set_column) so filenames never collide across
+        #: rewrites — old-generation files stay valid until the new journal
+        #: is atomically swapped in, then get garbage-collected.
+        self._gen = 0
+        self._next_chunk_id = 0
+        self._journal_records = 0
+        #: Streaming readers (iter_chunks) holding a chunk snapshot; chunk
+        #: file GC defers while any are active.
+        self._active_readers = 0
+        self._pending_gc = False
+        #: Set when the chunk list was rebuilt in place (set_column) while
+        #: on-disk chunk state existed: flushed chunk files no longer
+        #: describe the data and the store must rewrite a fresh generation
+        #: on the next save.
+        self._rewrite_needed = False
         if columns:
             self.append_columns(columns)
+
+    # -- storage wiring (set by DatasetStore) --------------------------------
+
+    def attach_storage(self, chunk_dir: str, journal_path: str,
+                       ram_budget_bytes: Optional[int] = None) -> None:
+        """Wire the on-disk chunk tier: where flushed/evicted chunks go and
+        how much column data may stay resident in host RAM."""
+        with self._data_lock:
+            self._chunk_dir = chunk_dir
+            self._journal_path = journal_path
+            self._ram_budget = ram_budget_bytes or None
+            self._maybe_evict_locked()
+
+    @property
+    def mem_bytes(self) -> int:
+        """Estimated bytes of chunk data resident in host RAM."""
+        with self._data_lock:
+            return sum(c.data_bytes for c in self._chunks if c.in_memory)
+
+    @property
+    def data_bytes(self) -> int:
+        """Estimated total bytes of column data (resident or spilled)."""
+        with self._data_lock:
+            return sum(c.data_bytes for c in self._chunks)
 
     # -- writes -------------------------------------------------------------
 
@@ -120,8 +255,9 @@ class Dataset:
                     f"chunk fields mismatch: missing={missing} extra={extra}")
             cols = {k: cols[k] for k in self.metadata.fields}  # reorder
         with self._data_lock:
-            self._chunks.append(cols)
+            self._chunks.append(_Chunk(cols))
             self._consolidated = None
+            self._maybe_evict_locked()
 
     def append_rows(self, rows: List[Dict[str, Any]]) -> None:
         """Append row dicts (used by result writers, e.g. predictions)."""
@@ -140,7 +276,11 @@ class Dataset:
     def set_column(self, name: str, values: np.ndarray) -> None:
         """Replace/add a full column (used by type coercion). Atomic:
         snapshot, length-check, and replacement all happen under the data
-        lock so a concurrent append can never be silently dropped."""
+        lock so a concurrent append can never be silently dropped.
+
+        Materializes the dataset (coercion is inherently O(n)); previously
+        flushed chunk files become stale and are rewritten on next save.
+        """
         values = np.asarray(values)
         with self._data_lock:
             cols = dict(self._consolidate_locked())
@@ -151,30 +291,248 @@ class Dataset:
             cols[name] = values
             if name not in self.metadata.fields:
                 self.metadata.fields.append(name)
-            self._chunks = [{f: cols[f] for f in self.metadata.fields}]
-            self._consolidated = self._chunks[0]
+            had_disk_state = (self._journal_records > 0
+                              or any(c.path is not None
+                                     for c in self._chunks))
+            self._chunks = [_Chunk({f: cols[f]
+                                    for f in self.metadata.fields})]
+            self._consolidated = None
+            # Only flag a rewrite when journaled files actually describe
+            # stale data; a purely in-memory dataset just flushes normally.
+            self._rewrite_needed = self._rewrite_needed or had_disk_state
+            self._maybe_evict_locked()
+
+    # -- chunk flushing / eviction ------------------------------------------
+
+    def _write_chunk_file_locked(self, chunk: _Chunk) -> Dict[str, Any]:
+        """Write one chunk to a new immutable parquet file (tmp + fsync +
+        rename + dir fsync) and return its journal record. The caller
+        commits the record to the journal."""
+        assert self._chunk_dir is not None
+        os.makedirs(self._chunk_dir, exist_ok=True)
+        fname = f"{self._gen:03d}-{self._next_chunk_id:05d}.parquet"
+        self._next_chunk_id += 1
+        final = os.path.join(self._chunk_dir, fname)
+        tmp = final + ".tmp"
+        cols = chunk.materialize()
+        write_chunk_parquet(tmp, cols, list(cols.keys()))
+        _fsync_file(tmp)
+        os.replace(tmp, final)
+        _fsync_dir(self._chunk_dir)
+        chunk.path = final
+        # Record what was actually written (consolidation may have promoted
+        # a view's dtype past what the chunk was appended with).
+        return {"file": fname, "rows": chunk.n_rows,
+                "bytes": chunk.data_bytes,
+                "dtypes": {f: str(a.dtype) for f, a in cols.items()}}
+
+    def _flush_chunk_locked(self, chunk: _Chunk) -> None:
+        """Write one chunk file, then its fsynced journal line — the commit
+        record. The file (and the rename) is fsynced *before* the journal
+        line, so a durable journal entry always references a durable file;
+        a crash between the two simply drops the chunk and recovery sees a
+        consistent prefix (the reference's metadata-first idiom at chunk
+        granularity, projection.py:78-123)."""
+        rec = self._write_chunk_file_locked(chunk)
+        with open(self._journal_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._journal_records += 1
+
+    def flush_new_chunks(self) -> List[str]:
+        """Flush every not-yet-persisted chunk (store.save's incremental
+        commit). Returns the chunk file paths written this call."""
+        written = []
+        with self._data_lock:
+            if self._chunk_dir is None:
+                return written
+            for c in self._chunks:
+                if c.path is None:
+                    self._flush_chunk_locked(c)
+                    written.append(c.path)
+        return written
+
+    def rewrite_generation(self) -> bool:
+        with self._data_lock:
+            return self._rewrite_generation_locked()
+
+    def _rewrite_generation_locked(self) -> bool:
+        """Atomically replace the on-disk chunk state after a set_column
+        rebuild. Returns whether a rewrite ran.
+
+        Crash-safe ordering: every new-generation chunk file is written and
+        fsynced first (old files untouched), then the *whole* new journal is
+        swapped in with one atomic rename. Whichever journal version
+        survives a crash references files that exist — there is never a
+        window where committed data is unrecoverable. Old-generation files
+        are garbage-collected afterwards (deferred while streaming readers
+        hold a chunk snapshot)."""
+        if not self._rewrite_needed or self._chunk_dir is None:
+            return False
+        self._gen += 1
+        self._next_chunk_id = 0
+        records = [self._write_chunk_file_locked(c)
+                   for c in self._chunks]
+        tmp = self._journal_path + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._journal_path)
+        _fsync_dir(os.path.dirname(self._journal_path))
+        self._journal_records = len(records)
+        self._rewrite_needed = False
+        self._gc_locked()
+        return True
+
+    def _gc_locked(self) -> None:
+        """Remove chunk files the journal no longer references (previous
+        generations, orphaned tmp files). Deferred while streaming readers
+        hold a chunk snapshot — their lazily-read files must stay valid."""
+        if self._chunk_dir is None or not os.path.isdir(self._chunk_dir):
+            return
+        if self._active_readers:
+            self._pending_gc = True
+            return
+        self._pending_gc = False
+        referenced = {os.path.basename(c.path) for c in self._chunks
+                      if c.path is not None}
+        for fn in os.listdir(self._chunk_dir):
+            if fn not in referenced:
+                try:
+                    os.remove(os.path.join(self._chunk_dir, fn))
+                except FileNotFoundError:
+                    pass
+
+    @property
+    def rewrite_needed(self) -> bool:
+        with self._data_lock:
+            return self._rewrite_needed
+
+    @property
+    def generation(self) -> int:
+        """Current chunk-file generation — bumps on every rewrite,
+        including rewrites committed inline by budget eviction; the store's
+        mirror uses it to detect journal replacement."""
+        with self._data_lock:
+            return self._gen
+
+    def journal_files(self) -> List[str]:
+        """Basenames of the chunk files the current state references —
+        the store's GC/mirror source of truth."""
+        with self._data_lock:
+            return [os.path.basename(c.path) for c in self._chunks
+                    if c.path is not None]
+
+    def maybe_evict(self) -> None:
+        with self._data_lock:
+            self._maybe_evict_locked()
+
+    def _maybe_evict_locked(self) -> None:
+        """Drop in-memory chunk data (flushing first) until under budget.
+
+        A pending rewrite (set_column) is committed inline first — flushing
+        against the stale journal would corrupt recovery, and waiting for a
+        store.save() that persist=False configurations never issue would
+        disable the budget permanently.
+        """
+        if self._ram_budget is None or self._chunk_dir is None:
+            return
+        if self._rewrite_needed:
+            self._rewrite_generation_locked()
+        mem = sum(c.data_bytes for c in self._chunks if c.in_memory)
+        if mem <= self._ram_budget:
+            return
+        for c in self._chunks:
+            if not c.in_memory or not c.evictable:
+                continue
+            if c.path is None:
+                self._flush_chunk_locked(c)
+            c.cols = None
+            mem -= c.data_bytes
+            if mem <= self._ram_budget:
+                break
+
+    def restore_chunks(self, records: List[Dict[str, Any]],
+                       chunk_dir: str) -> None:
+        """Rebuild the chunk list from journal records (store.load) — data
+        stays on disk until first access (lazy load). Files the journal no
+        longer references (a crash orphaned a half-committed generation)
+        are garbage-collected."""
+        chunks = []
+        max_gen, max_id = 0, -1
+        for rec in records:
+            dtypes = {f: np.dtype(dt) for f, dt in rec["dtypes"].items()}
+            chunks.append(_Chunk.on_disk(
+                os.path.join(chunk_dir, rec["file"]), rec["rows"], dtypes,
+                rec.get("bytes", 0)))
+            gen, cid = _parse_chunk_name(rec["file"])
+            if (gen, cid) > (max_gen, max_id):
+                max_gen, max_id = gen, cid
+        with self._data_lock:
+            self._chunks = chunks
+            self._consolidated = None
+            self._gen = max_gen
+            self._next_chunk_id = max_id + 1
+            self._journal_records = len(records)
+            prev_dir = self._chunk_dir
+            self._chunk_dir = chunk_dir
+            self._gc_locked()
+            self._chunk_dir = prev_dir
 
     # -- reads --------------------------------------------------------------
 
     @property
     def num_rows(self) -> int:
         with self._data_lock:
-            return sum(len(next(iter(c.values()))) for c in self._chunks)
+            return sum(c.n_rows for c in self._chunks)
+
+    def _total_bytes_locked(self) -> int:
+        return sum(c.data_bytes for c in self._chunks)
 
     def _consolidate_locked(self) -> Columns:
-        """Consolidate chunks; caller must hold ``_data_lock``."""
-        if self._consolidated is None:
-            if not self._chunks:
-                self._consolidated = {}
-            elif len(self._chunks) == 1:
-                self._consolidated = self._chunks[0]
-            else:
-                fields = self.metadata.fields
-                self._consolidated = {
-                    f: _concat([c[f] for c in self._chunks])
-                    for f in fields}
-                self._chunks = [self._consolidated]
-        return self._consolidated
+        """Full materialization; caller must hold ``_data_lock``.
+
+        Cached unless the dataset exceeds its RAM budget — over-budget
+        datasets materialize transiently (dense trainers need the full
+        design matrix on the way to the device) but the catalog's resident
+        footprint stays bounded by the chunk tier.
+        """
+        if self._consolidated is not None:
+            return self._consolidated
+        if not self._chunks:
+            self._consolidated = {}
+            return self._consolidated
+        fields = self.metadata.fields
+        loaded = [c.materialize() for c in self._chunks]
+        if len(loaded) == 1:
+            cols = loaded[0]
+        else:
+            cols = {f: _concat([lc[f] for lc in loaded]) for f in fields}
+        if (self._ram_budget is None
+                or self._total_bytes_locked() <= self._ram_budget):
+            self._consolidated = cols
+            if len(self._chunks) > 1:
+                # Don't keep two resident copies (per-chunk arrays + the
+                # concatenation): purely-in-memory chunk lists merge into
+                # one chunk sharing the consolidated arrays; chunks with
+                # disk bookkeeping to preserve re-point their resident data
+                # at *views* of the consolidation — same values (no drift,
+                # no re-reads), one buffer.
+                if (not self._rewrite_needed
+                        and all(c.path is None for c in self._chunks)):
+                    self._chunks = [_Chunk(cols)]
+                else:
+                    offset = 0
+                    for c in self._chunks:
+                        end = offset + c.n_rows
+                        c.cols = {f: cols[f][offset:end] for f in fields}
+                        c.dtypes = {f: cols[f].dtype for f in fields}
+                        c._evictable = None
+                        offset = end
+        return cols
 
     @property
     def columns(self) -> Columns:
@@ -188,6 +546,76 @@ class Dataset:
 
     def column(self, name: str) -> np.ndarray:
         return self.columns[name]
+
+    def iter_chunks(self, fields: Optional[List[str]] = None
+                    ) -> Iterator[Columns]:
+        """Stream the dataset chunk-by-chunk without full materialization —
+        the out-of-core compute path (histogram, projection). Spilled chunks
+        are read from their parquet files one at a time and not cached.
+
+        Yielded chunks carry *unified* dtypes matching what full
+        consolidation would produce: a field that is object (string) in any
+        chunk is object in every yielded chunk (`_concat`'s rule), and
+        mixed numeric dtypes promote to their ``np.result_type`` (so e.g. a
+        column integral in early chunks and float later yields float keys
+        everywhere, agreeing with ``value_counts`` on the same data).
+
+        The snapshot registers as an active reader for its lifetime: chunk
+        file GC (generation rewrites) defers until the iterator is
+        exhausted or closed, so lazily-read files stay valid. This is a
+        generator function — the snapshot and reader registration happen at
+        the first ``next()``, so an iterator that is never started never
+        leaks a reader count.
+        """
+        with self._data_lock:
+            chunks = list(self._chunks)
+            self._active_readers += 1
+        try:
+            want = fields
+            target: Dict[str, np.dtype] = {}
+            seen: Dict[str, set] = {}
+            for c in chunks:
+                for f, dt in c.dtypes.items():
+                    if want is None or f in want:
+                        seen.setdefault(f, set()).add(dt)
+            for f, dts in seen.items():
+                if len(dts) > 1:
+                    target[f] = (np.dtype(object)
+                                 if any(dt == object for dt in dts)
+                                 else np.result_type(*dts))
+            # Numeric→object coercion stringifies only when the object
+            # chunks hold strings (same rule as _concat); object chunks
+            # already on disk are strings by construction.
+            nonstringy = set()
+            if any(t == object for t in target.values()):
+                for c in chunks:
+                    ccols = c.cols
+                    if ccols is None:
+                        continue
+                    for f, a in ccols.items():
+                        if (target.get(f) == object and a.dtype == object
+                                and not is_stringy(a)):
+                            nonstringy.add(f)
+
+            def _coerce(f: str, a: np.ndarray) -> np.ndarray:
+                t = target.get(f)
+                if t is None or a.dtype == t:
+                    return a
+                if t != object:
+                    return a.astype(t)
+                return (a.astype(object) if f in nonstringy
+                        else stringify_numeric(a))
+
+            for c in chunks:
+                cols = c.materialize(want)
+                if target:
+                    cols = {f: _coerce(f, a) for f, a in cols.items()}
+                yield cols
+        finally:
+            with self._data_lock:
+                self._active_readers -= 1
+                if self._pending_gc and not self._active_readers:
+                    self._gc_locked()
 
     def rows(self, indices: np.ndarray) -> List[Dict[str, Any]]:
         """Materialize row documents (``_id`` = index+1) for the given
@@ -212,6 +640,81 @@ class Dataset:
         return np.stack(mats, axis=1)
 
 
+# -- chunk parquet IO --------------------------------------------------------
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably commit a rename: fsync the containing directory (POSIX —
+    best-effort on filesystems that reject directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _parse_chunk_name(fname: str) -> tuple:
+    """``GGG-NNNNN.parquet`` → (gen, id); legacy ``NNNNN.parquet`` → (0, id)."""
+    stem = fname[:-len(".parquet")] if fname.endswith(".parquet") else fname
+    parts = stem.split("-")
+    try:
+        if len(parts) == 2:
+            return int(parts[0]), int(parts[1])
+        return 0, int(parts[0])
+    except ValueError:
+        return 0, -1
+
+def write_chunk_parquet(path: str, cols: Columns,
+                        fields: List[str]) -> None:
+    """Columns → parquet. Object columns serialize as nullable strings
+    (non-string objects stringify — the store's value domain is
+    numbers/strings/null, matching the reference's Mongo documents)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    arrays, names = [], []
+    for fname in fields:
+        arr = cols[fname]
+        if arr.dtype == object:
+            arrays.append(pa.array([None if v is None else str(v)
+                                    for v in arr], type=pa.string()))
+        else:
+            arrays.append(pa.array(arr))
+        names.append(fname)
+    pq.write_table(pa.table(arrays, names=names), path)
+
+
+def read_chunk_parquet(path: str,
+                       fields: Optional[List[str]] = None) -> Columns:
+    """Parquet chunk file → Columns (string columns come back as object
+    arrays with ``None`` for nulls, numerics as their numpy dtypes)."""
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=fields)
+    cols: Columns = {}
+    for fname in table.column_names:
+        cols[fname] = table.column(fname).to_numpy(zero_copy_only=False)
+    return cols
+
+
+def is_stringy(a: np.ndarray) -> bool:
+    """Whether an object column holds only str/None — the CSV value domain
+    (as opposed to e.g. float scores with None gaps from ``append_rows``)."""
+    return all(v is None or isinstance(v, str) for v in a)
+
+
 def _concat(arrays: List[np.ndarray]) -> np.ndarray:
     """Concatenate column chunks, reconciling dtypes.
 
@@ -219,13 +722,18 @@ def _concat(arrays: List[np.ndarray]) -> np.ndarray:
     in early chunks and object (string) later (e.g. 'N/A' first appears at
     row 70k). A whole-file parse would have made every value a string, so on
     conflict numeric values are stringified (ints exactly; NaN → None) to
-    keep one consistent value domain for queries and value_counts."""
+    keep one consistent value domain for queries and value_counts. That
+    rule only applies when the object chunks actually hold strings: object
+    chunks carrying numbers (floats with None gaps) keep their numeric
+    values and the numeric chunks join them as objects."""
     has_obj = any(a.dtype == object for a in arrays)
     if has_obj and any(a.dtype != object for a in arrays):
-        arrays = [stringify_numeric(a) if a.dtype != object else a
-                  for a in arrays]
-    elif has_obj:
-        arrays = [a.astype(object) for a in arrays]
+        if all(is_stringy(a) for a in arrays if a.dtype == object):
+            arrays = [stringify_numeric(a) if a.dtype != object else a
+                      for a in arrays]
+        else:
+            arrays = [a.astype(object) if a.dtype != object else a
+                      for a in arrays]
     return np.concatenate(arrays)
 
 

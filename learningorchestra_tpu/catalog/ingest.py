@@ -142,6 +142,12 @@ def ingest_csv_url(store: DatasetStore, name: str, url: str,
     try:
         for cols in parse_csv_chunks(reader, cfg.ingest_chunk_rows, cfg):
             ds.append_columns(cols)
+            if cfg.persist:
+                # Incremental commit: O(chunk) journaled flush per parsed
+                # chunk — the durability granularity the reference got from
+                # per-row Mongo inserts (database.py:171-181), thousands of
+                # rows at a time instead of one.
+                store.save(name)
     finally:
         # Unblock and reap the downloader even when the parser raised
         # mid-stream; otherwise it parks forever on the bounded queue

@@ -85,6 +85,10 @@ class DatasetStore:
         self.cfg = cfg or global_settings
         self._lock = threading.RLock()
         self._datasets: Dict[str, Dataset] = {}
+        #: (generation, journal bytes) already mirrored to the replica,
+        #: per dataset — keeps per-save mirroring O(delta) and detects
+        #: journal replacement across rewrites/restarts.
+        self._mirror_state: Dict[str, tuple] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -101,6 +105,7 @@ class DatasetStore:
             meta = Metadata(name=name, url=url, parent=parent,
                             finished=finished, extra=dict(extra or {}))
             ds = Dataset(meta, columns)
+            self._attach_storage(ds)
             self._datasets[name] = ds
         if self.cfg.persist:
             # Persist the metadata-first state immediately: a crash between
@@ -126,9 +131,14 @@ class DatasetStore:
             if name not in self._datasets:
                 raise DatasetNotFound(name)
             del self._datasets[name]
+            self._mirror_state.pop(name, None)
         path = self._path(name)
         if os.path.isdir(path):
             shutil.rmtree(path, ignore_errors=True)
+        if self.cfg.replica_root:
+            rpath = os.path.join(self.cfg.replica_root, name)
+            if os.path.isdir(rpath):
+                shutil.rmtree(rpath, ignore_errors=True)
 
     def names(self) -> List[str]:
         with self._lock:
@@ -176,6 +186,12 @@ class DatasetStore:
         n_meta = 1 if _doc_matches(meta_doc, query) else 0
         if n_meta and skip == 0:
             docs.append(meta_doc)
+        if len(docs) >= limit:
+            # Early out before touching column data: the client's 3-second
+            # completion poll is read(limit=1) (reference __init__.py:26-32)
+            # and must stay O(1) — consolidating an out-of-core dataset to
+            # answer it would read every chunk from disk.
+            return docs
         # One consistent snapshot for the whole read: ds.columns is an
         # immutable consolidation, so mask lengths and row materialization
         # can't diverge even while an ingest job is appending.
@@ -215,38 +231,163 @@ class DatasetStore:
         return column_value_counts(self.get(name).columns[field])
 
     # -- persistence ---------------------------------------------------------
+    #
+    # On-disk layout per dataset (store_root/<name>/):
+    #   metadata.json        — small, rewritten atomically (tmp+rename)
+    #   journal.jsonl        — append-only, fsynced chunk-commit log
+    #   chunks/00000.parquet — immutable chunk files (tmp+rename)
+    # Legacy single-file layout (data.parquet) remains loadable.
+    #
+    # A commit (``save``) costs O(new chunks) + one small metadata write —
+    # never a full rewrite — replacing the reference's per-row Mongo
+    # inserts (database.py:176) with journaled columnar chunk appends.
 
     def _path(self, name: str) -> str:
         # Defense in depth alongside validate_name at create time.
         validate_name(name)
         return os.path.join(self.cfg.store_root, name)
 
-    def save(self, name: str) -> None:
-        """Write dataset as parquet + metadata.json under store_root."""
-        import pyarrow as pa
-        import pyarrow.parquet as pq
+    def _attach_storage(self, ds: Dataset) -> None:
+        """Wire a dataset to its chunk dir / journal / RAM budget. Spilling
+        works even with persist=False (chunk files land under store_root
+        and die with the dataset)."""
+        path = os.path.join(self.cfg.store_root, ds.metadata.name)
+        budget = (self.cfg.ram_budget_mb * (1 << 20)
+                  if self.cfg.ram_budget_mb else None)
+        ds.attach_storage(os.path.join(path, "chunks"),
+                          os.path.join(path, "journal.jsonl"),
+                          ram_budget_bytes=budget)
 
+    def save(self, name: str) -> None:
+        """Incremental commit: flush new chunks + rewrite metadata.json.
+
+        Cost is O(data appended since the last save), so streaming ingest
+        can checkpoint per chunk (the reference's durability granularity
+        was per row via Mongo; database.py:171-181). After a set_column
+        rebuild, a new chunk generation is written and the journal swapped
+        atomically (old files stay valid until the swap — no crash window
+        loses committed data), then stale files are garbage-collected.
+        """
         ds = self.get(name)
         path = self._path(name)
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "metadata.json"), "w") as f:
+        if not ds.rewrite_generation():    # GCs its own stale files
+            ds.flush_new_chunks()
+        # A journaled layout supersedes any legacy single-file copy.
+        if os.path.isfile(os.path.join(path, "journal.jsonl")):
+            try:
+                os.remove(os.path.join(path, "data.parquet"))
+            except FileNotFoundError:
+                pass
+        tmp = os.path.join(path, "metadata.json.tmp")
+        with open(tmp, "w") as f:
             json.dump(ds.metadata.to_doc(), f, default=str)
-        if ds.num_rows:
-            cols = ds.columns
-            arrays, names = [], []
-            for fname in ds.metadata.fields:
-                arr = cols[fname]
-                if arr.dtype == object:
-                    arrays.append(pa.array([None if v is None else str(v)
-                                            for v in arr]))
-                else:
-                    arrays.append(pa.array(arr))
-                names.append(fname)
-            pq.write_table(pa.table(arrays, names=names),
-                           os.path.join(path, "data.parquet"))
+        os.replace(tmp, os.path.join(path, "metadata.json"))
+        ds.maybe_evict()
+        if self.cfg.replica_root:
+            self._mirror(name)
+
+    def _mirror(self, name: str) -> None:
+        """Copy the dataset's committed delta to the replica root — the
+        availability tier standing in for the reference's Mongo
+        primary/secondary replication (docker-compose.yml:27-91).
+
+        Per-save cost is O(what was committed since the last mirror): the
+        journal bytes appended since the tracked per-dataset offset name
+        exactly the chunk files to copy (immutable, uniquely named across
+        generations — including files flushed by budget evictions between
+        saves). Files are copied *before* the journal bytes referencing
+        them land, so the replica is itself always a consistent prefix.
+
+        The delta path only applies while the journal is known to be
+        append-only since the last mirror: a generation change (rewrites,
+        including ones committed inline by budget eviction) or an unknown
+        offset (fresh process) falls back to a wholesale journal replace +
+        GC of unreferenced replica files.
+        """
+        ds = self.get(name)
+        src = self._path(name)
+        dst = os.path.join(self.cfg.replica_root, name)
+        os.makedirs(os.path.join(dst, "chunks"), exist_ok=True)
+        src_chunks = os.path.join(src, "chunks")
+        src_journal = os.path.join(src, "journal.jsonl")
+        dst_journal = os.path.join(dst, "journal.jsonl")
+
+        def copy_files(records):
+            for rec in records:
+                fn = rec.get("file")
+                if not fn:
+                    continue
+                s = os.path.join(src_chunks, fn)
+                d = os.path.join(dst, "chunks", fn)
+                if os.path.isfile(s) and not os.path.isfile(d):
+                    shutil.copy2(s, d)
+
+        gen = ds.generation
+        state = self._mirror_state.get(name)
+        if os.path.isfile(src_journal):
+            size = os.path.getsize(src_journal)
+            full = (state is None or state[0] != gen or state[1] > size
+                    or not os.path.isfile(dst_journal))
+            if full:
+                copy_files(self._read_journal(src_journal))
+                tmp = dst_journal + ".tmp"
+                shutil.copy2(src_journal, tmp)
+                os.replace(tmp, dst_journal)
+                referenced = set(ds.journal_files())
+                dst_chunks = os.path.join(dst, "chunks")
+                for fn in os.listdir(dst_chunks):
+                    if fn not in referenced:
+                        try:
+                            os.remove(os.path.join(dst_chunks, fn))
+                        except FileNotFoundError:
+                            pass
+            elif size > state[1]:
+                with open(src_journal, "rb") as s_f:
+                    s_f.seek(state[1])
+                    delta = s_f.read(size - state[1])
+                records = []
+                for line in delta.decode("utf-8").splitlines():
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+                copy_files(records)
+                with open(dst_journal, "ab") as d_f:
+                    d_f.write(delta)
+            self._mirror_state[name] = (gen, size)
+        meta = os.path.join(src, "metadata.json")
+        if os.path.isfile(meta):
+            tmp = os.path.join(dst, "metadata.json.tmp")
+            shutil.copy2(meta, tmp)
+            os.replace(tmp, os.path.join(dst, "metadata.json"))
+
+    @staticmethod
+    def _read_journal(path: str) -> List[Dict[str, Any]]:
+        """Parse journal records, tolerating a torn final line (a crash
+        mid-append commits nothing; the preceding prefix stays valid)."""
+        records = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break  # torn tail write — everything before is valid
+        except FileNotFoundError:
+            pass
+        return records
 
     def load(self, name: str) -> Dataset:
-        """Load one persisted dataset into the catalog."""
+        """Load one persisted dataset into the catalog.
+
+        Journaled chunk layout loads *lazily* — only metadata and the
+        journal are read; column data stays in its chunk files until first
+        access. Legacy single-file (data.parquet) layout reads eagerly.
+        """
         import pyarrow.parquet as pq
 
         path = self._path(name)
@@ -255,14 +396,22 @@ class DatasetStore:
             raise DatasetNotFound(name)
         with open(meta_path) as f:
             meta = Metadata.from_doc(json.load(f))
-        columns: Columns = {}
-        data_path = os.path.join(path, "data.parquet")
-        if os.path.isfile(data_path):
-            table = pq.read_table(data_path)
-            for fname in table.column_names:
-                arr = table.column(fname).to_numpy(zero_copy_only=False)
-                columns[fname] = arr
-        ds = Dataset(meta, columns or None)
+        records = self._read_journal(os.path.join(path, "journal.jsonl"))
+        ds = Dataset(meta)
+        if records:
+            ds.restore_chunks(records, os.path.join(path, "chunks"))
+        else:
+            data_path = os.path.join(path, "data.parquet")
+            if os.path.isfile(data_path):
+                table = pq.read_table(data_path)
+                columns: Columns = {
+                    fname: table.column(fname).to_numpy(zero_copy_only=False)
+                    for fname in table.column_names}
+                if columns:
+                    ds.append_columns(
+                        {f: columns[f] for f in meta.fields if f in columns}
+                        if meta.fields else columns)
+        self._attach_storage(ds)
         with self._lock:
             self._datasets[name] = ds
         return ds
@@ -270,12 +419,26 @@ class DatasetStore:
     def load_all(self) -> List[str]:
         """Recover the catalog from disk at startup (crash resume).
 
+        If a replica root is configured, datasets present there but missing
+        from the primary (disk loss) are restored first — the failover
+        analogue of the reference's replica-set recovery
+        (docker-compose.yml:27-91).
+
         Datasets recovered with ``finished: false`` were mid-job when the
         process died; their jobs are gone, so they are marked failed —
         every dataset reaches a terminal state across restarts (the
         reference left finished:false forever, SURVEY.md §5).
         """
         root = self.cfg.store_root
+        if self.cfg.replica_root and os.path.isdir(self.cfg.replica_root):
+            for name in sorted(os.listdir(self.cfg.replica_root)):
+                rmeta = os.path.join(self.cfg.replica_root, name,
+                                     "metadata.json")
+                pmeta = os.path.join(root, name, "metadata.json")
+                if os.path.isfile(rmeta) and not os.path.isfile(pmeta):
+                    shutil.copytree(os.path.join(self.cfg.replica_root, name),
+                                    os.path.join(root, name),
+                                    dirs_exist_ok=True)
         loaded = []
         if os.path.isdir(root):
             for name in sorted(os.listdir(root)):
@@ -302,17 +465,33 @@ _OPS = {
 }
 
 
+def _apply_op(op: str, vals: np.ndarray, operand: Any) -> np.ndarray:
+    """One operator over a column; object columns evaluate elementwise so
+    mixed/None values never raise (a None cell simply doesn't match —
+    Mongo's null-comparison behavior, which the vectorized path can't give
+    for object dtypes)."""
+    fn = _OPS[op]
+    if vals.dtype == object:
+        out = np.zeros(len(vals), dtype=bool)
+        for i, v in enumerate(vals):
+            try:
+                out[i] = bool(fn(v, operand))
+            except TypeError:
+                out[i] = False
+        return out
+    with np.errstate(invalid="ignore"):
+        return np.asarray(fn(vals, operand), dtype=bool)
+
+
 def _eval_cond(vals: np.ndarray, cond: Any) -> np.ndarray:
     if isinstance(cond, dict):
         mask = np.ones(len(vals), dtype=bool)
         for op, operand in cond.items():
             if op not in _OPS:
                 raise ValueError(f"unsupported query operator: {op}")
-            with np.errstate(invalid="ignore"):
-                mask &= np.asarray(_OPS[op](vals, operand), dtype=bool)
+            mask &= _apply_op(op, vals, operand)
         return mask
-    with np.errstate(invalid="ignore"):
-        return np.asarray(vals == cond, dtype=bool)
+    return _apply_op("$eq", vals, cond)
 
 
 def _doc_matches(doc: Dict[str, Any], query: Dict[str, Any]) -> bool:

@@ -38,6 +38,20 @@ class Settings:
     )
     #: Persist datasets to disk on every commit (finished-flip).
     persist: bool = field(default_factory=lambda: _env("LO_TPU_PERSIST", True, bool))
+    #: Soft cap (MiB) on column data resident in host RAM *per dataset*;
+    #: 0 = unlimited. Over budget, chunks flush to immutable parquet chunk
+    #: files and are evicted — the out-of-core tier replacing the
+    #: reference's disk-backed Mongo collections (database.py:133-216).
+    ram_budget_mb: int = field(
+        default_factory=lambda: _env("LO_TPU_RAM_BUDGET_MB", 0)
+    )
+    #: Optional second directory mirroring every committed dataset (chunk
+    #: files + journal + metadata). Standing in for the reference's Mongo
+    #: primary/secondary replica set (docker-compose.yml:27-91): if the
+    #: primary store_root is lost, load_all() restores from the replica.
+    replica_root: str = field(
+        default_factory=lambda: _env("LO_TPU_REPLICA_ROOT", "")
+    )
 
     # --- ingestion ---------------------------------------------------------
     #: CSV ingest chunk size (rows) for the streaming loader. Replaces the

@@ -76,10 +76,21 @@ def field_counts(runtime: MeshRuntime, col: np.ndarray) -> Dict:
     return column_value_counts(col)
 
 
+def merge_counts(total: Dict, part: Dict) -> None:
+    """Accumulate one chunk's value→count map into the running total."""
+    for k, v in part.items():
+        total[k] = total.get(k, 0) + v
+
+
 def create_histogram(store: DatasetStore, runtime: MeshRuntime,
                      parent: str, name: str, fields: List[str],
                      existing: bool = False) -> None:
     """Build the histogram dataset (sync core; run under JobManager).
+
+    Streams the parent one chunk at a time (``iter_chunks``) and merges
+    per-chunk counts, so datasets larger than host RAM histogram without
+    ever being fully materialized — matching the reference's disk-backed
+    Mongo aggregation (histogram.py:49-74) at out-of-core scale.
 
     ``existing=True`` means the API layer already created the output dataset
     (metadata-first protocol); otherwise it is created here.
@@ -89,7 +100,9 @@ def create_histogram(store: DatasetStore, runtime: MeshRuntime,
     if missing:
         raise ValueError(f"fields not in dataset: {missing}")
     ds = store.get(name) if existing else store.create(name, parent=parent)
-    rows = [{"field": f, "counts": field_counts(runtime, parent_ds.columns[f])}
-            for f in fields]
-    ds.append_rows(rows)
+    totals: Dict[str, Dict] = {f: {} for f in fields}
+    for cols in parent_ds.iter_chunks(list(fields)):
+        for f in fields:
+            merge_counts(totals[f], field_counts(runtime, cols[f]))
+    ds.append_rows([{"field": f, "counts": totals[f]} for f in fields])
     store.finish(name)

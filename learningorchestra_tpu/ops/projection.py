@@ -6,10 +6,14 @@ metadata with ``finished=True`` (reference projection.py:104-125) — because
 its rows live as BSON documents that must be physically rewritten.
 
 Here columns are already independent arrays, so projection is a zero-copy
-column gather: the output dataset references the parent's arrays directly
-(copy-on-write applies — type coercion replaces whole columns, never mutates
-in place). The metadata-first / finished-flip protocol and field validation
-(fields ⊆ parent.fields, projection.py:141-167) are preserved exactly.
+column gather *per chunk*: the output dataset references the parent's chunk
+arrays directly (copy-on-write applies — type coercion replaces whole
+columns, never mutates in place). Streaming chunk-by-chunk with an
+incremental commit after each keeps projection working on datasets larger
+than host RAM (the parent's spilled chunks load one at a time; the output
+spills under the same budget). The metadata-first / finished-flip protocol
+and field validation (fields ⊆ parent.fields, projection.py:141-167) are
+preserved exactly.
 """
 
 from __future__ import annotations
@@ -26,6 +30,8 @@ def create_projection(store: DatasetStore, parent: str, name: str,
     if missing:
         raise ValueError(f"fields not in dataset: {missing}")
     ds = store.get(name) if existing else store.create(name, parent=parent)
-    cols = parent_ds.columns
-    ds.append_columns({f: cols[f] for f in fields})
+    for cols in parent_ds.iter_chunks(list(fields)):
+        ds.append_columns({f: cols[f] for f in fields})
+        if store.cfg.persist:
+            store.save(name)
     store.finish(name)
