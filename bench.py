@@ -1,17 +1,25 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Headline metric: wall-clock of the model_builder 5-classifier sweep
-(lr/dt/rf/gb/nb) on a Titanic-shaped dataset (891 train / 418 test rows,
-7 features) — the reference's own published workload. Baseline: the only
-number the reference publishes, 41.870 s for a *single* NaiveBayes fit on
-this data via Spark (reference docs/database_api.md:87; BASELINE.md).
-``vs_baseline`` = baseline_seconds / our_seconds for all five classifiers,
-i.e. >1 means we fit 5 models faster than the reference fit 1.
+Headline metric (BASELINE.md north star): wall-clock of the model_builder
+5-classifier sweep (lr/dt/rf/gb/nb) fitting HIGGS-11M (11,000,000 x 28
+float32, binary label) through the full service path — catalog dataset →
+design matrix → sharded fits on the mesh → metrics → prediction datasets
+for a 100k evaluation split.
+
+Baseline: the reference's Spark 2.4.7 stack is not runnable here and it
+publishes no HIGGS numbers, so the Spark-CPU stand-in is sklearn with the
+same hyperparameters (depth-5 trees, 20 trees/rounds, histogram GBT —
+favoring the baseline) measured on this machine at 1.1M rows and
+extrapolated linearly (conservative for trees): 108.7 CPU-seconds at 1.1M
+→ 1087 s at 11M (benchmarks/baseline_cpu.py, recorded in BASELINE.md).
+``vs_baseline`` = baseline_seconds / our_seconds. The north-star target is
+≥10x (BASELINE.json).
 
 Steady-state timing: one warmup sweep populates XLA's compilation cache
 (also persisted to disk so repeated bench runs stay warm), then the
 measured sweep runs — matching how the long-lived server process actually
-behaves (the reference's 41.87 s likewise excludes Spark cluster startup).
+behaves (the reference's published 41.87 s NaiveBayes fit likewise
+excludes Spark cluster startup).
 """
 
 from __future__ import annotations
@@ -21,27 +29,23 @@ import time
 
 import numpy as np
 
+#: sklearn 5-family sweep, same hyperparameters, CPU process-time at 1.1M
+#: rows x10 (benchmarks/baseline_cpu.py; see BASELINE.md).
+CPU_BASELINE_11M_S = 1087.2
 
-def _titanic_like(n, seed):
+N_TRAIN = 11_000_000
+N_TEST = 100_000
+D = 28
+
+
+def _higgs_like(n, seed):
     rng = np.random.default_rng(seed)
-    pclass = rng.integers(1, 4, n)
-    sex = rng.choice(["male", "female"], n)
-    age = np.where(rng.random(n) < 0.2, np.nan, rng.normal(30, 12, n))
-    sibsp = rng.integers(0, 5, n)
-    parch = rng.integers(0, 4, n)
-    fare = rng.lognormal(2.5, 1.0, n)
-    logit = (1.4 * (sex == "female") - 0.6 * pclass + 0.008 * fare
-             - 0.02 * np.nan_to_num(age, nan=30.0) + 0.9)
-    surv = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.int64)
-    return {
-        "Pclass": pclass.astype(np.int64),
-        "Sex": np.array(sex, dtype=object),
-        "Age": age,
-        "SibSp": sibsp.astype(np.int64),
-        "Parch": parch.astype(np.int64),
-        "Fare": fare,
-        "Survived": surv,
-    }
+    X = rng.normal(size=(n, D)).astype(np.float32)
+    w = np.random.default_rng(12345).normal(size=D).astype(np.float32)
+    y = ((X @ w + 0.5 * rng.normal(size=n).astype(np.float32)) > 0)
+    cols = {f"f{i}": X[:, i] for i in range(D)}
+    cols["label"] = y.astype(np.int64)
+    return cols
 
 
 def main() -> None:
@@ -52,37 +56,49 @@ def main() -> None:
     except Exception:
         pass
 
-    from learningorchestra_tpu.config import Settings
     from learningorchestra_tpu.catalog.store import DatasetStore
+    from learningorchestra_tpu.config import Settings
     from learningorchestra_tpu.models.builder import ModelBuilder
     from learningorchestra_tpu.parallel.mesh import MeshRuntime
 
     cfg = Settings()
     cfg.persist = False
+    cfg.persist_models = False
+    # One chip: the device queue serializes real compute anyway, and five
+    # concurrently dispatched 11M-row fits thrash HBM (measured 363 s vs
+    # 106 s sequential). Thread overlap pays only for small workloads.
+    cfg.max_concurrent_fits = 1
     store = DatasetStore(cfg)
     runtime = MeshRuntime(cfg)
-    store.create("bench_train", columns=_titanic_like(891, 0), finished=True)
-    store.create("bench_test", columns=_titanic_like(418, 1), finished=True)
+    store.create("bench_train", columns=_higgs_like(N_TRAIN, 0),
+                 finished=True)
+    store.create("bench_test", columns=_higgs_like(N_TEST, 1), finished=True)
     mb = ModelBuilder(store, runtime, cfg)
     classifiers = ["lr", "dt", "rf", "gb", "nb"]
 
-    # warmup (compile)
-    mb.build("bench_train", "bench_test", "warm", classifiers, "Survived")
+    # warmup (compile + host->device transfer)
+    mb.build("bench_train", "bench_test", "warm", classifiers, "label")
 
     t0 = time.time()
     reports = mb.build("bench_train", "bench_test", "bench", classifiers,
-                       "Survived")
+                       "label")
     elapsed = time.time() - t0
 
     bad = [r.kind for r in reports if "error" in r.metrics]
     assert not bad, f"failed fits: {bad}"
-    baseline = 41.870062828063965  # reference nb fit (BASELINE.md)
+    # All five families must actually learn the workload (guards against a
+    # fast-but-broken fit gaming the wall-clock).
+    accs = {r.kind: round(r.metrics.get("accuracy", 0.0), 4)
+            for r in reports}
+    assert all(a > 0.65 for a in accs.values()), accs
     print(json.dumps({
         "metric": "model_builder 5-classifier sweep wall-clock "
-                  "(Titanic-shape 891 rows, steady-state)",
+                  "(HIGGS-11M, steady-state; accs "
+                  + ",".join(f"{k}={v}" for k, v in sorted(accs.items()))
+                  + ")",
         "value": round(elapsed, 4),
         "unit": "seconds",
-        "vs_baseline": round(baseline / elapsed, 2),
+        "vs_baseline": round(CPU_BASELINE_11M_S / elapsed, 2),
     }))
 
 

@@ -1,0 +1,67 @@
+"""CPU stand-in baseline for the HIGGS 5-classifier sweep (BASELINE.md).
+
+The reference publishes no HIGGS numbers and its Spark 2.4.7 stack is not
+runnable in this environment, so the Spark-CPU baseline is approximated by
+sklearn on the same synthetic HIGGS-shape data with the *same
+hyperparameters* our trainers default to (depth-5 trees, 20 trees/rounds,
+32 bins) — and sklearn's fast histogram GBT, so the comparison favors the
+baseline. Runs on a 1/10th subsample (1.1M rows, single core) and the
+recorded extrapolation to 11M is linear — conservative for the tree
+families, whose cost grows superlinearly.
+
+CPU seconds are reported as ``process_time`` (pure compute, robust to
+machine sharing). Run once; results are recorded in BASELINE.md and used
+as the denominator of bench.py's ``vs_baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _higgs_like(n, d=28, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = ((X @ w + 0.5 * rng.normal(size=n)) > 0).astype(np.int32)
+    return X, y
+
+
+def main(n=1_100_000):
+    from sklearn.ensemble import (HistGradientBoostingClassifier,
+                                  RandomForestClassifier)
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.tree import DecisionTreeClassifier
+
+    X, y = _higgs_like(n)
+    models = {
+        "lr": LogisticRegression(max_iter=300, n_jobs=1),
+        "dt": DecisionTreeClassifier(max_depth=5),
+        "rf": RandomForestClassifier(n_estimators=20, max_depth=5, n_jobs=1),
+        "gb": HistGradientBoostingClassifier(max_iter=20, max_depth=5,
+                                             max_bins=32),
+        "nb": GaussianNB(),
+    }
+    total_cpu = 0.0
+    for kind, model in models.items():
+        t0, c0 = time.time(), time.process_time()
+        model.fit(X, y)
+        wall, cpu = time.time() - t0, time.process_time() - c0
+        total_cpu += cpu
+        acc = float((model.predict(X[:100_000]) == y[:100_000]).mean())
+        print(json.dumps({"bench": f"cpu_baseline.fit.{kind}",
+                          "wall_s": round(wall, 2), "cpu_s": round(cpu, 2),
+                          "acc_100k": round(acc, 4), "rows": n}), flush=True)
+    print(json.dumps({"bench": "cpu_baseline.sweep_total",
+                      "cpu_s": round(total_cpu, 2), "rows": n,
+                      "extrapolated_11m_s": round(total_cpu * 10, 1)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_100_000)

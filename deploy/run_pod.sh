@@ -5,6 +5,13 @@
 # the same server binary runs on each host and jax.distributed joins them
 # into one device mesh.
 #
+# Topology: HOST_ID 0 serves HTTP and owns the catalog (clients talk only
+# to it); every other host runs the SPMD worker loop
+# (learningorchestra_tpu/parallel/spmd.py) executing the mesh computations
+# process 0 dispatches. All hosts must see the same LO_TPU_STORE_ROOT
+# (shared filesystem) — it is the data plane workers rebuild job inputs
+# from, the role MongoDB played for the reference's Spark executors.
+#
 # Usage:
 #   deploy/run_pod.sh                      # single host, all local chips
 #   COORDINATOR=host0:8476 NUM_HOSTS=4 HOST_ID=2 deploy/run_pod.sh

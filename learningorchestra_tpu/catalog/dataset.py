@@ -203,6 +203,10 @@ class Dataset:
         #: file GC defers while any are active.
         self._active_readers = 0
         self._pending_gc = False
+        #: Derived-artifact cache (design matrices): {key: (snapshot_id,
+        #: value)}, valid only while the consolidation snapshot it was
+        #: built from is current. See ``memo``.
+        self._memo: Dict[Any, tuple] = {}
         #: Set when the chunk list was rebuilt in place (set_column) while
         #: on-disk chunk state existed: flushed chunk files no longer
         #: describe the data and the store must rewrite a fresh generation
@@ -616,6 +620,48 @@ class Dataset:
                 self._active_readers -= 1
                 if self._pending_gc and not self._active_readers:
                     self._gc_locked()
+
+    #: Most derived artifacts kept per dataset (each can pin a full design
+    #: matrix, so the cap bounds resident memory in long-lived servers).
+    _MEMO_CAP = 4
+
+    def memo(self, key, builder, token=None):
+        """Cache a derived artifact (e.g. a design matrix) against the
+        current consolidation snapshot; invalidated by appends/coercion.
+        ``token`` adds an extra validity object compared by *identity*
+        (e.g. the preprocessing state a test matrix was built with).
+
+        Keeping the artifact's *identity* stable across repeated reads is
+        what lets downstream identity-keyed caches hit — in particular the
+        mesh runtime's host→device transfer cache, so a server fitting
+        repeatedly on the same dataset re-uses the on-device copy instead
+        of re-transferring gigabytes per build. Snapshots and tokens are
+        stored and compared as objects (``is``), never as raw ``id()``
+        integers — a recycled address must not resurrect a stale entry.
+        Entries from superseded snapshots are purged, and the cache is
+        size-capped, so invalidated design matrices don't pin memory for
+        the dataset's lifetime. Over-budget (out-of-core) datasets never
+        cache their consolidation, so nothing giant gets pinned for them
+        either.
+        """
+        cols = self.columns  # consolidates; snapshot identity = validity
+        with self._data_lock:
+            current = self._consolidated is cols
+            for k in [k for k, (snap, _, _) in self._memo.items()
+                      if snap is not cols]:
+                del self._memo[k]
+            if current:
+                hit = self._memo.get(key)
+                if hit is not None and hit[1] is token:
+                    return hit[2]
+        val = builder()
+        if current:
+            with self._data_lock:
+                if self._consolidated is cols:
+                    self._memo[key] = (cols, token, val)
+                    while len(self._memo) > self._MEMO_CAP:
+                        del self._memo[next(iter(self._memo))]
+        return val
 
     def rows(self, indices: np.ndarray) -> List[Dict[str, Any]]:
         """Materialize row documents (``_id`` = index+1) for the given

@@ -17,7 +17,7 @@ from typing import Any, Callable, Dict
 
 import numpy as np
 
-from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.parallel.mesh import MeshRuntime, host_rows
 
 
 @dataclass
@@ -39,13 +39,13 @@ class TrainedModel:
         X = np.asarray(X, np.float32)
         if len(X) <= self.PREDICT_CHUNK:
             X_dev, n = runtime.shard_rows(X)
-            return np.asarray(self.predict_proba_fn(self.params, X_dev))[:n]
+            return host_rows(self.predict_proba_fn(self.params, X_dev))[:n]
         outs = []
         for i in range(0, len(X), self.PREDICT_CHUNK):
             chunk = np.ascontiguousarray(X[i:i + self.PREDICT_CHUNK])
             X_dev, n = runtime.shard_rows(chunk)
             outs.append(
-                np.asarray(self.predict_proba_fn(self.params, X_dev))[:n])
+                host_rows(self.predict_proba_fn(self.params, X_dev))[:n])
         return np.concatenate(outs, axis=0)
 
     def predict(self, runtime: MeshRuntime, X: np.ndarray) -> np.ndarray:

@@ -20,6 +20,7 @@ dataset ``<name>_<classifier>`` per classifier, metrics in its metadata.
 
 from __future__ import annotations
 
+import json
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -32,6 +33,7 @@ from learningorchestra_tpu.models.metrics import classification_metrics
 from learningorchestra_tpu.models.persistence import ModelRegistry
 from learningorchestra_tpu.models.registry import get_trainer
 from learningorchestra_tpu.ops import preprocess
+from learningorchestra_tpu.parallel import spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
 from learningorchestra_tpu.utils.profiling import (
     device_trace, op_timer, timed)
@@ -78,9 +80,14 @@ class ModelBuilder:
         train_ds = self.store.get(train)
         test_ds = self.store.get(test)
         hparams = hparams or {}
+        multi = spmd.is_multiprocess()
 
         pp_meta = None
         if preprocessor_code is not None:
+            if multi:
+                raise PermissionError(
+                    "exec preprocessing cannot run SPMD (workers rebuild "
+                    "inputs deterministically); use declarative steps")
             if not self.cfg.allow_exec_preprocessing:
                 raise PermissionError(
                     "exec preprocessing is disabled; enable "
@@ -89,11 +96,20 @@ class ModelBuilder:
                 preprocessor_code, train_ds, test_ds, label)
             feature_fields = [f"f{i}" for i in range(X_train.shape[1])]
         else:
-            X_train, y_train, feature_fields, state = preprocess.design_matrix(
-                train_ds, label, steps)
-            X_test, y_test, _, _ = preprocess.design_matrix(
-                test_ds, label, steps, state=state,
-                feature_fields=feature_fields)
+            # Memoized per dataset-snapshot: repeat builds on the same data
+            # reuse the identical X arrays, so the runtime's transfer cache
+            # keeps the on-device copies (re-transferring an 11M-row matrix
+            # over PCIe per build would dwarf the fits themselves).
+            steps_key = json.dumps(list(steps), sort_keys=True, default=str)
+            X_train, y_train, feature_fields, state = train_ds.memo(
+                ("design", label, steps_key),
+                lambda: preprocess.design_matrix(train_ds, label, steps))
+            X_test, y_test, _, _ = test_ds.memo(
+                ("design_t", label, steps_key, tuple(feature_fields)),
+                lambda: preprocess.design_matrix(
+                    test_ds, label, steps, state=state,
+                    feature_fields=feature_fields),
+                token=state)
             # Everything needed to apply the identical pipeline to future
             # datasets when the fitted model is re-served (persistence.py).
             pp_meta = {"steps": list(steps), "state": state,
@@ -137,22 +153,49 @@ class ModelBuilder:
                                    preds, probs, report)
             return report
 
+        def fit_guarded(c: str) -> FitReport:
+            try:
+                return fit_one(c)
+            except Exception as exc:  # noqa: BLE001 — per-model boundary
+                self.store.fail(f"{prediction_name}_{c}",
+                                f"{type(exc).__name__}: {exc}")
+                return FitReport(kind=c, fit_time=0.0,
+                                 metrics={"error": str(exc)})
+
+        if multi:
+            # Multi-process SPMD: broadcast one build spec, then run the
+            # fits sequentially — every process must execute the same
+            # collective program in the same order (parallel/spmd.py), so
+            # the thread-pool overlap (single-process FAIR behavior) does
+            # not apply. Datasets must be durable first: workers rebuild
+            # identical inputs from the shared store.
+            if not self.cfg.persist:
+                raise RuntimeError(
+                    "multi-process builds require a persisted shared "
+                    "store (LO_TPU_PERSIST=1 on a shared store_root)")
+            self.store.save(train)
+            self.store.save(test)
+            with device_trace(self.cfg), spmd.dispatch_guard():
+                # Row counts pin the snapshot: a concurrent ingest commit
+                # between this save and a worker's load must not change
+                # the collective program's shapes (workers truncate to
+                # these counts).
+                spmd.dispatch({
+                    "op": "build", "train": train, "test": test,
+                    "label": label, "steps": list(steps),
+                    "classifiers": list(classifiers), "hparams": hparams,
+                    "n_train": int(len(X_train)),
+                    "n_test": int(len(X_test)),
+                })
+                return [fit_guarded(c) for c in classifiers]
+
         # Concurrent fits (reference: 5-way ThreadPoolExecutor + FAIR pool).
         # One device trace spans the whole build (JAX allows a single
         # active trace per process, so per-fit tracing would collide).
         with device_trace(self.cfg), ThreadPoolExecutor(
                 max_workers=self.cfg.max_concurrent_fits) as pool:
-            futures = {c: pool.submit(fit_one, c) for c in classifiers}
-            reports = []
-            for c, fut in futures.items():
-                try:
-                    reports.append(fut.result())
-                except Exception as exc:  # noqa: BLE001 — per-model boundary
-                    self.store.fail(f"{prediction_name}_{c}",
-                                    f"{type(exc).__name__}: {exc}")
-                    reports.append(FitReport(kind=c, fit_time=0.0,
-                                             metrics={"error": str(exc)}))
-        return reports
+            futures = {c: pool.submit(fit_guarded, c) for c in classifiers}
+            return [fut.result() for fut in futures.values()]
 
     def predict(self, model_name: str, dataset: str, out_name: str,
                 existing: bool = False) -> None:
@@ -174,10 +217,15 @@ class ModelBuilder:
         if not existing:
             self.store.create(out_name, parent=dataset,
                               extra={"model": model_name, "kind": man["kind"]})
-        with timed("model_predict"), device_trace(self.cfg):
+        with timed("model_predict"), device_trace(self.cfg), \
+                spmd.dispatch_guard():
             X, _, _, _ = preprocess.design_matrix(
                 ds, pp["label"], pp["steps"], state=pp["state"],
                 feature_fields=pp["feature_fields"])
+            if spmd.is_multiprocess():
+                self.store.save(dataset)
+                spmd.dispatch({"op": "predict", "model": model_name,
+                               "dataset": dataset, "n_rows": int(len(X))})
             probs = model.predict_proba(self.runtime, X)
         preds = np.argmax(probs, axis=1)
         self._save_predictions(out_name, ds, preds, probs,

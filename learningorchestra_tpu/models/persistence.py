@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from learningorchestra_tpu.catalog.store import validate_name
 from learningorchestra_tpu.config import Settings
 from learningorchestra_tpu.models.base import TrainedModel
@@ -56,12 +58,19 @@ class ModelRegistry:
         import orbax.checkpoint as ocp
 
         d = self._dir(name)
+        # Replicated params → host numpy before checkpointing: keeps the
+        # save a process-local write under multi-process operation (orbax
+        # would otherwise coordinate a distributed save that only process 0
+        # participates in).
+        import jax
+
+        params = jax.tree.map(np.asarray, model.params)
         with self._lock:
             if os.path.isdir(d):
                 shutil.rmtree(d)
             os.makedirs(d)
             ocp.PyTreeCheckpointer().save(
-                os.path.join(d, "params"), model.params)
+                os.path.join(d, "params"), params)
             manifest = {
                 "name": name,
                 "kind": model.kind,

@@ -59,10 +59,17 @@ def _mesh_bincount(codes: jax.Array, n_valid: jax.Array, *,
 
 
 def field_counts(runtime: MeshRuntime, col: np.ndarray) -> Dict:
-    """Value→count dict for one column, device path when it pays off."""
+    """Value→count dict for one column, device path when it pays off.
+
+    Multi-process pods take the host path: the device bincount's psum is
+    not SPMD-dispatched to workers, and process 0 entering it alone would
+    wedge the pod (counting is cheap relative to a dispatch round-trip).
+    """
+    from learningorchestra_tpu.parallel import spmd
+
     if len(col) == 0:
         return {}
-    if col.dtype.kind in "iu":
+    if col.dtype.kind in "iu" and not spmd.is_multiprocess():
         lo, hi = int(col.min()), int(col.max())
         num_bins = hi - lo + 1
         if 0 < num_bins <= MAX_DEVICE_BINS:

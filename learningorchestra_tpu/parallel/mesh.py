@@ -89,18 +89,48 @@ def shard_rows(mesh: Mesh, arr: np.ndarray) -> Tuple[jax.Array, int]:
 
     Returns the device array (rows padded to the data-axis size) and the
     true row count for masking.
+
+    Multi-process: ``jax.device_put`` of a host array only addresses local
+    devices, so the global array is assembled per-process from a callback —
+    each process materializes exactly the row blocks its addressable shards
+    own (every process holds the same host array, rebuilt from the shared
+    store; SURVEY.md §2's Mongo-as-shared-data-plane role).
     """
     arr = np.asarray(arr)
     n_shards = mesh.shape[DATA_AXIS]
     padded, n = pad_rows(arr, n_shards)
     spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
-    out = jax.device_put(padded, NamedSharding(mesh, spec))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        out = jax.make_array_from_callback(
+            padded.shape, sharding, lambda idx: padded[idx])
+    else:
+        out = jax.device_put(padded, sharding)
     return out, n
 
 
 def replicate(mesh: Mesh, x) -> jax.Array:
     """Replicate a value across every mesh device (fully-replicated spec)."""
-    return jax.device_put(np.asarray(x), NamedSharding(mesh, P()))
+    x = np.asarray(x)
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx])
+    return jax.device_put(x, sharding)
+
+
+def host_rows(x: jax.Array) -> np.ndarray:
+    """Device array → host numpy, valid under multi-process.
+
+    Row-sharded outputs are not fully addressable when the mesh spans
+    processes; ``process_allgather`` (a collective — every process must
+    call it, which the SPMD dispatch protocol guarantees) gathers the
+    global value. Single-process is a plain copy."""
+    if jax.process_count() > 1 and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 class MeshRuntime:

@@ -1,0 +1,278 @@
+"""SPMD job dispatch — how one HTTP process drives a multi-process mesh.
+
+The reference scales out by sending Spark jobs from a driver service to a
+standalone master that fans work across worker JVMs (reference
+docker-compose.yml:123-163, model_builder.py:70-95). Under ``jax.distributed``
+the equivalent constraint is SPMD: every process in the pod must execute the
+same jitted computations in the same order, or the collectives XLA emits
+(psum/all_gather over ICI/DCN) deadlock. But jobs arrive dynamically over
+HTTP on one process only.
+
+Design: **process 0 owns the catalog and the REST surface; every other
+process runs a worker loop** (`worker_loop`). Before process 0 runs a mesh
+computation for a job, it sends a job spec to every worker over a
+persistent TCP channel (newline-delimited JSON — the minimal analogue of
+the reference's Spark RPC control plane, ports 7077/41352 + py4j). A
+device collective cannot play this role: workers idle between jobs, and
+collective rendezvous carries initialization/barrier timeouts (Gloo's 30 s
+handshake on CPU), so the control plane must tolerate unbounded idle —
+TCP recv does. Workers decode the spec, rebuild identical host inputs
+from the *shared dataset store* (the data plane replacing Mongo, which
+played exactly this role for Spark executors), and execute the same
+sequence of jitted calls. Results live replicated or are all-gathered;
+process 0 persists them, workers discard.
+
+The channel's address defaults to the jax.distributed coordinator host
+(process 0) at ``LO_TPU_JOB_PORT`` (coordinator port + 1 when unset).
+
+Single-process runs (and the CPU-mesh test rig) skip all of this: every
+entry point no-ops when ``jax.process_count() == 1``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("lo_tpu.spmd")
+
+
+def is_multiprocess() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def _job_addr() -> tuple:
+    """(host, port) of the job channel — coordinator host, port + 1."""
+    coord = os.environ.get("LO_TPU_COORDINATOR", "127.0.0.1:8476")
+    host, _, port = coord.rpartition(":")
+    job_port = int(os.environ.get("LO_TPU_JOB_PORT", int(port) + 1))
+    return host or "127.0.0.1", job_port
+
+
+class _JobChannel:
+    """Process-0 end: accepts one connection per worker, fans job specs
+    out as JSON lines. Worker connections are accepted lazily in the
+    background so the server can start before (or after) its workers."""
+
+    def __init__(self, n_workers: int):
+        self.n_workers = n_workers
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        _, port = _job_addr()
+        self._srv = socket.create_server(("", port))
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="lo-spmd-accept")
+        t.start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+
+    def send(self, spec: Dict[str, Any]) -> None:
+        """Block until every worker is connected, then fan out the spec."""
+        deadline = time.time() + 120.0
+        while True:
+            with self._lock:
+                if len(self._conns) >= self.n_workers:
+                    break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"only {len(self._conns)}/{self.n_workers} workers "
+                    "connected to the job channel")
+            time.sleep(0.05)
+        data = (json.dumps(spec) + "\n").encode("utf-8")
+        with self._lock:
+            for conn in self._conns:
+                conn.sendall(data)
+
+
+_channel: Optional[_JobChannel] = None
+_channel_lock = threading.Lock()
+_dispatch_lock = threading.Lock()
+
+
+def _get_channel() -> _JobChannel:
+    import jax
+
+    global _channel
+    with _channel_lock:
+        if _channel is None:
+            _channel = _JobChannel(jax.process_count() - 1)
+        return _channel
+
+
+def dispatch(spec: Dict[str, Any]) -> None:
+    """Process-0 side: announce the next mesh job to every worker. No-op
+    single-process. Caller must then execute exactly the device-op
+    sequence `run_job` executes for this spec."""
+    if not is_multiprocess():
+        return
+    _get_channel().send(spec)
+
+
+class dispatch_guard:
+    """Serializes mesh jobs under multi-process operation.
+
+    Collective programs from concurrently dispatched jobs would interleave
+    differently on each process and deadlock; the guard makes dispatch +
+    compute atomic. Single-process mode is a no-op (concurrent fits stay
+    overlapped, the FAIR-scheduler behavior)."""
+
+    def __enter__(self):
+        if is_multiprocess():
+            _dispatch_lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        if is_multiprocess():
+            _dispatch_lock.release()
+        return False
+
+
+# -- worker side -------------------------------------------------------------
+
+def run_build_job(store, runtime, spec: Dict[str, Any]) -> None:
+    """Execute a model-build job's device-op sequence, mirroring
+    ``ModelBuilder.build``'s per-classifier compute exactly (fit →
+    predict_proba with the same shapes, same order). Host-only work
+    (persistence, prediction datasets, metrics) is process-0 business and
+    is skipped here."""
+    from learningorchestra_tpu.models.registry import get_trainer
+    from learningorchestra_tpu.ops import preprocess
+
+    train_ds = store.load(spec["train"])
+    test_ds = store.load(spec["test"])
+    steps = spec.get("steps") or ()
+    label = spec["label"]
+    hparams = spec.get("hparams") or {}
+    X_train, y_train, ff, state = preprocess.design_matrix(
+        train_ds, label, steps)
+    X_test, y_test, _, _ = preprocess.design_matrix(
+        test_ds, label, steps, state=state, feature_fields=ff)
+    # The spec pins process 0's snapshot: an ingest commit between its
+    # save and this load may have appended rows, and a shape mismatch
+    # would wedge every collective. Rows only ever append, so truncating
+    # reproduces the snapshot prefix.
+    n_train, n_test = spec.get("n_train"), spec.get("n_test")
+    if n_train is not None:
+        if len(X_train) < n_train or len(X_test) < n_test:
+            raise RuntimeError(
+                f"worker sees fewer rows than the dispatched snapshot "
+                f"({len(X_train)}/{n_train} train, {len(X_test)}/{n_test} "
+                "test) — shared store out of sync")
+        X_train, y_train = X_train[:n_train], y_train[:n_train]
+        X_test = X_test[:n_test]
+        y_test = y_test[:n_test] if y_test is not None else None
+    num_classes = int(max(int(y_train.max()) + 1,
+                          2 if y_test is None else int(y_test.max()) + 1))
+    for c in spec["classifiers"]:
+        try:
+            trainer = get_trainer(c)
+            model = trainer(runtime, X_train, y_train, num_classes,
+                            **hparams.get(c, {}))
+            model.predict_proba(runtime, X_test)
+        except Exception:  # noqa: BLE001 — mirror process 0's per-model boundary
+            log.exception("worker fit %s failed", c)
+
+
+def run_predict_job(store, runtime, spec: Dict[str, Any]) -> None:
+    """Mirror ``ModelBuilder.predict``'s device ops for a re-served model."""
+    from learningorchestra_tpu.models.persistence import ModelRegistry
+    from learningorchestra_tpu.ops import preprocess
+
+    registry = ModelRegistry(store.cfg)
+    man, model = registry.load(spec["model"])
+    pp = man["preprocess"]
+    ds = store.load(spec["dataset"])
+    X, _, _, _ = preprocess.design_matrix(
+        ds, pp["label"], pp["steps"], state=pp["state"],
+        feature_fields=pp["feature_fields"])
+    n = spec.get("n_rows")
+    if n is not None:
+        if len(X) < n:
+            raise RuntimeError(
+                f"worker sees fewer rows ({len(X)}) than the dispatched "
+                f"snapshot ({n}) — shared store out of sync")
+        X = X[:n]
+    model.predict_proba(runtime, X)
+
+
+def _connect_to_controller(timeout_s: float = 120.0) -> socket.socket:
+    host, port = _job_addr()
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            sock = socket.create_connection((host, port), timeout=5.0)
+            sock.settimeout(None)  # jobs may be hours apart
+            return sock
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def worker_loop(store, runtime) -> None:
+    """Non-zero processes: block on the next job spec, execute its device
+    ops, repeat until shutdown. The store must point at the same (shared)
+    store_root process 0 persists into — the data plane that replaces the
+    reference's Mongo-as-shared-storage for Spark executors."""
+    import jax
+
+    log.info("worker %d/%d entering SPMD loop",
+             jax.process_index(), jax.process_count())
+    sock = _connect_to_controller()
+    buf = b""
+    while True:
+        while b"\n" not in buf:
+            data = sock.recv(1 << 16)
+            if not data:
+                log.info("controller closed the job channel; exiting")
+                return
+            buf += data
+        line, buf = buf.split(b"\n", 1)
+        spec = json.loads(line.decode("utf-8"))
+        op = spec.get("op")
+        if op == "shutdown":
+            log.info("worker %d shutting down", jax.process_index())
+            return
+        try:
+            if op == "build":
+                run_build_job(store, runtime, spec)
+            elif op == "predict":
+                run_predict_job(store, runtime, spec)
+            else:
+                log.error("unknown job op: %r", op)
+        except Exception:  # noqa: BLE001 — keep the loop alive
+            log.exception("worker job %r failed", op)
+
+
+def require_single_process(what: str) -> None:
+    """Guard for mesh ops that are not yet SPMD-dispatched to workers:
+    running their collectives on process 0 alone would wedge the pod.
+    Raises a clean client error (406) instead."""
+    if is_multiprocess():
+        raise ValueError(
+            f"{what} is not SPMD-dispatched yet and cannot run on a "
+            "multi-process pod; run it on a single-process deployment")
+
+
+def shutdown_workers() -> None:
+    """Process 0: release every worker from its loop (server shutdown)."""
+    if is_multiprocess():
+        try:
+            _get_channel().send({"op": "shutdown"})
+        except TimeoutError:
+            pass

@@ -27,10 +27,34 @@ def main() -> None:
     settings.store_root = args.store_root
 
     distributed.initialize()
+    import jax
+
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # Pod topology: process 0 owns the catalog and the REST surface;
+        # every other process runs the SPMD worker loop, executing the
+        # same mesh computations process 0 dispatches (parallel/spmd.py).
+        # The store points at the shared store_root — the data plane the
+        # reference's Spark executors got from Mongo.
+        from learningorchestra_tpu.catalog.store import DatasetStore
+        from learningorchestra_tpu.parallel import spmd
+        from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+        print(f"learningorchestra_tpu worker "
+              f"{jax.process_index()}/{jax.process_count()} "
+              f"(devices: {distributed.process_info()['devices']})",
+              flush=True)
+        spmd.worker_loop(DatasetStore(settings), MeshRuntime(settings))
+        return
+
+    from learningorchestra_tpu.parallel import spmd
+
     app = App(settings, recover=not args.no_recover)
     print(f"learningorchestra_tpu serving on {args.host}:{args.port} "
           f"(devices: {distributed.process_info()['devices']})", flush=True)
-    app.serve()
+    try:
+        app.serve()
+    finally:
+        spmd.shutdown_workers()
 
 
 if __name__ == "__main__":

@@ -39,6 +39,9 @@ def _pca_project(X, n_valid, *, k):
 def pca_embed(runtime: MeshRuntime, X: np.ndarray,
               k: int = 2) -> np.ndarray:
     """(n, d) host matrix → (n, k) principal-component embedding."""
+    from learningorchestra_tpu.parallel import spmd
+
+    spmd.require_single_process("pca")
     X_dev, n = runtime.shard_rows(np.asarray(X, np.float32))
     emb, _ = _pca_project(X_dev, runtime.replicate(np.int32(n)), k=k)
     return np.asarray(emb)[:n]

@@ -170,6 +170,9 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
                seed: int = 0, pca_dims: int = 50,
                tile: int = _TILE) -> np.ndarray:
     """(n, d) host matrix → (n, 2) t-SNE embedding."""
+    from learningorchestra_tpu.parallel import spmd
+
+    spmd.require_single_process("tsne")
     X = np.asarray(X, np.float32)
     n, d = X.shape
     if d > pca_dims:

@@ -1,0 +1,76 @@
+"""Child process for the 2-process SPMD test (tests/test_multiprocess.py).
+
+Run as: python tests/spmd_child.py <process_id> <num_processes> <coord_port>
+<shared_root>. Process 0 plays the controller (catalog owner, dispatches a
+model build); the rest run the worker loop — exactly the pod topology
+deploy/run_pod.sh launches.
+"""
+
+import json
+import os
+import sys
+
+pid, nprocs, port, root = (int(sys.argv[1]), int(sys.argv[2]),
+                           int(sys.argv[3]), sys.argv[4])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+# The SPMD job channel derives its address from the coordinator's.
+os.environ["LO_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nprocs, process_id=pid)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: E402
+from learningorchestra_tpu.config import Settings  # noqa: E402
+from learningorchestra_tpu.parallel import spmd  # noqa: E402
+from learningorchestra_tpu.parallel.mesh import MeshRuntime  # noqa: E402
+
+assert jax.process_count() == nprocs, jax.process_count()
+assert jax.device_count() == 4 * nprocs, jax.device_count()
+
+cfg = Settings()
+cfg.store_root = os.path.join(root, "store")
+cfg.image_root = os.path.join(root, "img")
+cfg.persist = True
+store = DatasetStore(cfg)
+runtime = MeshRuntime(cfg)
+
+
+def make_split(seed, n):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    y = ((a + b + 0.2 * rng.normal(size=n)) > 0).astype(np.int64)
+    return {"a": a, "b": b, "label": y}
+
+
+if pid == 0:
+    from learningorchestra_tpu.models.builder import ModelBuilder
+
+    store.create("sp_train", columns=make_split(0, 4000), finished=True)
+    store.create("sp_test", columns=make_split(1, 1000), finished=True)
+    mb = ModelBuilder(store, runtime, cfg)
+    try:
+        reports = mb.build("sp_train", "sp_test", "sp_pred", ["lr", "nb"],
+                           "label")
+    finally:
+        spmd.shutdown_workers()
+    out = {r.kind: dict(r.metrics, fit_time=r.fit_time) for r in reports}
+    # The prediction datasets must exist with finished metadata + rows.
+    for kind in ("lr", "nb"):
+        doc = store.read(f"sp_pred_{kind}", limit=1)[0]
+        assert doc["finished"] is True and "error" not in doc, doc
+        out[kind]["pred_rows"] = store.get(f"sp_pred_{kind}").num_rows
+    with open(os.path.join(root, "result.json"), "w") as f:
+        json.dump(out, f)
+else:
+    spmd.worker_loop(store, runtime)
