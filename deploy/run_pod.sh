@@ -16,10 +16,14 @@
 #   deploy/run_pod.sh                      # single host, all local chips
 #   COORDINATOR=host0:8476 NUM_HOSTS=4 HOST_ID=2 deploy/run_pod.sh
 #
-# On Cloud TPU pod slices, prefer the gcloud fan-out (topology
-# auto-discovered, no env needed):
-#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
-#     --command="cd app && deploy/run_pod.sh"
+# The coordinator env is REQUIRED to form a pod — it wires both
+# jax.distributed and the SPMD job channel (coordinator port + 1, or
+# LO_TPU_JOB_PORT). On Cloud TPU pod slices, fan out with per-worker ids:
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all --command='
+#     cd app && COORDINATOR=<worker0-ip>:8476 NUM_HOSTS=4 \
+#     HOST_ID=$(curl -sH "Metadata-Flavor: Google" \
+#       http://metadata/computeMetadata/v1/instance/attributes/agent-worker-number) \
+#     deploy/run_pod.sh'
 
 set -euo pipefail
 cd "$(dirname "$0")/.."

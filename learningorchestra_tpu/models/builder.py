@@ -180,12 +180,18 @@ class ModelBuilder:
                 # between this save and a worker's load must not change
                 # the collective program's shapes (workers truncate to
                 # these counts).
+                # State + feature fields pin the preprocessing snapshot
+                # too: a worker refitting stats over a longer dataset
+                # would otherwise build numerically different (or wider)
+                # matrices than process 0's.
                 spmd.dispatch({
                     "op": "build", "train": train, "test": test,
                     "label": label, "steps": list(steps),
                     "classifiers": list(classifiers), "hparams": hparams,
                     "n_train": int(len(X_train)),
                     "n_test": int(len(X_test)),
+                    "state": spmd.jsonable_state(state),
+                    "feature_fields": list(feature_fields),
                 })
                 return [fit_guarded(c) for c in classifiers]
 

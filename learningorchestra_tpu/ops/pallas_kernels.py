@@ -40,15 +40,18 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _repulsion_kernel(xr_ref, yr_ref, vr_ref, xc_ref, yc_ref, vc_ref,
-                      z_ref, fx_ref, fy_ref):
+def _repulsion_kernel(off_ref, xr_ref, yr_ref, vr_ref, xc_ref, yc_ref,
+                      vc_ref, z_ref, fx_ref, fy_ref):
     """One (row-tile i, col-tile j) cell of the pairwise Student-t grid.
 
-    Refs: xr/yr/vr are (TILE, 1) row-block coordinate/valid columns;
-    xc/yc/vc are (1, TILE) col-block rows. Outputs: fx/fy accumulate the
-    repulsive force numerator per row block (revisited across j, so the
-    block stays resident in VMEM while the column tiles stream past);
-    z is the (1, 1) SMEM running sum of all q_ij (the normalizer Z).
+    Refs: off is the (1, 1) SMEM global row offset of the query block
+    (row-sharded multi-chip t-SNE passes each shard's range; 0 for the
+    full embedding); xr/yr/vr are (TILE, 1) row-block coordinate/valid
+    columns; xc/yc/vc are (1, TILE) col-block rows. Outputs: fx/fy
+    accumulate the repulsive force numerator per row block (revisited
+    across j, so the block stays resident in VMEM while the column tiles
+    stream past); z is the (1, 1) SMEM running sum of all q_ij (the
+    normalizer Z).
     """
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -58,8 +61,10 @@ def _repulsion_kernel(xr_ref, yr_ref, vr_ref, xc_ref, yc_ref, vc_ref,
     dy = yr_ref[:] - yc_ref[:]
     q = 1.0 / (1.0 + dx * dx + dy * dy)
 
-    # Mask invalid (padding) rows/cols and the self-pair diagonal.
-    rid = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 0)
+    # Mask invalid (padding) rows/cols and the self-pair diagonal
+    # (row ids are global via the shard offset; col ids are global).
+    rid = (off_ref[0] + i * tile
+           + jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 0))
     cid = j * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
     q = q * (vr_ref[:] * vc_ref[:]) * (rid != cid).astype(jnp.float32)
 
@@ -88,6 +93,52 @@ def _repulsion_kernel(xr_ref, yr_ref, vr_ref, xc_ref, yc_ref, vc_ref,
         z_ref[0, 0] += zp
 
 
+def tsne_repulsion_rows(Yq: jax.Array, validq: jax.Array, Y: jax.Array,
+                        valid: jax.Array, offset, *, tile: int = TILE):
+    """Repulsion for the query row block ``Yq`` (global rows
+    [offset, offset+len(Yq))) against every column of ``Y`` — the
+    per-shard unit of the row-sharded multi-chip embed (viz/tsne.py).
+    Returns (Z_partial, F (len(Yq), 2)); summing Z partials over shards
+    reproduces ``tsne_repulsion``'s Z exactly.
+    """
+    nq = Yq.shape[0]
+    n = Y.shape[0]
+    assert nq % tile == 0 and n % tile == 0, (nq, n, tile)
+    off = jnp.asarray(offset, jnp.int32).reshape(1)
+    xr = Yq[:, 0:1]
+    yr = Yq[:, 1:2]
+    vr = validq[:, None]
+    xc = Y[:, 0][None, :]
+    yc = Y[:, 1][None, :]
+    vc = valid[None, :]
+
+    grid = (nq // tile, n // tile)
+    # The offset rides scalar prefetch (SMEM); index maps therefore take
+    # the scalar ref as a trailing argument.
+    row_spec = pl.BlockSpec((tile, 1), lambda i, j, off: (i, 0))
+    col_spec = pl.BlockSpec((1, tile), lambda i, j, off: (0, j))
+    out_row_spec = pl.BlockSpec((tile, 1), lambda i, j, off: (i, 0))
+    z_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    z, fx, fy = pl.pallas_call(
+        _repulsion_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[row_spec, row_spec, row_spec,
+                      col_spec, col_spec, col_spec],
+            out_specs=[z_spec, out_row_spec, out_row_spec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((nq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((nq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(off, xr, yr, vr, xc, yc, vc)
+    return z[0, 0], jnp.concatenate([fx, fy], axis=1)
+
+
 @partial(jax.jit, static_argnames=("tile",))
 def tsne_repulsion(Y: jax.Array, valid: jax.Array, *, tile: int = TILE):
     """Exact t-SNE repulsion over all pairs of a 2-D embedding.
@@ -97,33 +148,4 @@ def tsne_repulsion(Y: jax.Array, valid: jax.Array, *, tile: int = TILE):
     Σ_{i≠j} q_ij and the (n, 2) force numerator Σ_j q²_ij (y_i − y_j) —
     identical semantics to the pure-XLA ``rep_block`` scan in viz/tsne.py.
     """
-    n = Y.shape[0]
-    assert n % tile == 0, (n, tile)
-    nb = n // tile
-    xr = Y[:, 0:1]
-    yr = Y[:, 1:2]
-    vr = valid[:, None]
-    xc = Y[:, 0][None, :]
-    yc = Y[:, 1][None, :]
-    vc = valid[None, :]
-
-    grid = (nb, nb)
-    row_spec = pl.BlockSpec((tile, 1), lambda i, j: (i, 0))
-    col_spec = pl.BlockSpec((1, tile), lambda i, j: (0, j))
-    out_row_spec = pl.BlockSpec((tile, 1), lambda i, j: (i, 0))
-    z_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
-
-    z, fx, fy = pl.pallas_call(
-        _repulsion_kernel,
-        grid=grid,
-        in_specs=[row_spec, row_spec, row_spec,
-                  col_spec, col_spec, col_spec],
-        out_specs=[z_spec, out_row_spec, out_row_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
-        ],
-        interpret=_interpret(),
-    )(xr, yr, vr, xc, yc, vc)
-    return z[0, 0], jnp.concatenate([fx, fy], axis=1)
+    return tsne_repulsion_rows(Y, valid, Y, valid, 0, tile=tile)

@@ -37,8 +37,10 @@ def initialize(coordinator_address: Optional[str] = None,
     - ``LO_TPU_COORDINATOR`` (host:port of process 0),
     - ``LO_TPU_NUM_PROCESSES``, ``LO_TPU_PROCESS_ID``.
 
-    On TPU VMs with cloud metadata available, ``jax.distributed.initialize``
-    auto-discovers all three. No-op when unset (single-host dev/test).
+    The coordinator address is required to form a pod: besides seeding
+    ``jax.distributed``, its host also locates the SPMD job channel
+    (parallel/spmd.py — coordinator host, port + 1). No-op when unset
+    (single-host dev/test).
     """
     global _initialized
     if _initialized:

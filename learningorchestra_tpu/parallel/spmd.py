@@ -56,15 +56,43 @@ def _job_addr() -> tuple:
     return host or "127.0.0.1", job_port
 
 
+class _Conn:
+    """One worker connection with line-buffered reads."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+
+    def recv_line(self, timeout: Optional[float]) -> Optional[str]:
+        """One JSON line, or None on EOF/timeout/error."""
+        self.sock.settimeout(timeout)
+        try:
+            while b"\n" not in self.buf:
+                data = self.sock.recv(1 << 16)
+                if not data:
+                    return None
+                self.buf += data
+        except OSError:
+            return None
+        finally:
+            self.sock.settimeout(None)
+        line, self.buf = self.buf.split(b"\n", 1)
+        return line.decode("utf-8")
+
+
 class _JobChannel:
     """Process-0 end: accepts one connection per worker, fans job specs
-    out as JSON lines. Worker connections are accepted lazily in the
-    background so the server can start before (or after) its workers."""
+    out as JSON lines and collects per-worker ready/fail acks. Worker
+    connections are accepted in the background so the server can start
+    before (or after) its workers. Dead connections are pruned on IO
+    errors — a worker process cannot rejoin a running pod (its
+    jax.distributed identity died with it), so the channel's job is to
+    fail *cleanly*, not to resync."""
 
     def __init__(self, n_workers: int):
         self.n_workers = n_workers
         self._lock = threading.Lock()
-        self._conns: List[socket.socket] = []
+        self._conns: List[_Conn] = []
         _, port = _job_addr()
         self._srv = socket.create_server(("", port))
         t = threading.Thread(target=self._accept_loop, daemon=True,
@@ -74,29 +102,77 @@ class _JobChannel:
     def _accept_loop(self) -> None:
         while True:
             try:
-                conn, _ = self._srv.accept()
+                sock, _ = self._srv.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
-                self._conns.append(conn)
+                self._conns.append(_Conn(sock))
 
-    def send(self, spec: Dict[str, Any]) -> None:
-        """Block until every worker is connected, then fan out the spec."""
-        deadline = time.time() + 120.0
-        while True:
-            with self._lock:
-                if len(self._conns) >= self.n_workers:
-                    break
+    def _live(self) -> List[_Conn]:
+        with self._lock:
+            return list(self._conns)
+
+    def _drop(self, conn: _Conn) -> None:
+        with self._lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _sendall(self, conns: List[_Conn], msg: Dict[str, Any]) -> None:
+        data = (json.dumps(msg) + "\n").encode("utf-8")
+        for conn in conns:
+            try:
+                conn.sock.sendall(data)
+            except OSError:
+                self._drop(conn)
+
+    def dispatch(self, spec: Dict[str, Any],
+                 timeout_s: float = 120.0) -> None:
+        """Two-phase fan-out: send the spec, wait for every worker's
+        ``ready`` ack (host-side prep done — datasets loaded, shapes
+        agreed), then release them with ``go``. Any failed/missing ack
+        aborts the round on every worker and raises, so process 0 never
+        enters a collective some worker will not join. (A failure *after*
+        go — mid-collective — still wedges; that is inherent to
+        collectives without timeouts and surfaces at pod supervision.)"""
+        deadline = time.time() + timeout_s
+        while len(self._live()) < self.n_workers:
             if time.time() > deadline:
+                self._sendall(self._live(), {"op": "abort"})
                 raise TimeoutError(
-                    f"only {len(self._conns)}/{self.n_workers} workers "
+                    f"only {len(self._live())}/{self.n_workers} workers "
                     "connected to the job channel")
             time.sleep(0.05)
-        data = (json.dumps(spec) + "\n").encode("utf-8")
-        with self._lock:
-            for conn in self._conns:
-                conn.sendall(data)
+        conns = self._live()[:self.n_workers]
+        self._sendall(conns, spec)
+        failures = []
+        for conn in conns:
+            line = conn.recv_line(max(1.0, deadline - time.time()))
+            ack = None
+            if line is not None:
+                try:
+                    ack = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+            if ack is None:
+                self._drop(conn)
+                failures.append("worker connection lost before ack")
+            elif ack.get("status") != "ready":
+                failures.append(ack.get("error", "worker prep failed"))
+        if failures:
+            self._sendall(self._live(), {"op": "abort"})
+            raise RuntimeError(
+                f"SPMD dispatch aborted ({len(failures)} worker(s)): "
+                + "; ".join(failures[:3]))
+        self._sendall(conns, {"op": "go"})
+
+    def broadcast(self, msg: Dict[str, Any]) -> None:
+        """Fire-and-forget control message (shutdown) — no ack round."""
+        self._sendall(self._live(), msg)
 
 
 _channel: Optional[_JobChannel] = None
@@ -114,13 +190,24 @@ def _get_channel() -> _JobChannel:
         return _channel
 
 
+def ensure_channel() -> None:
+    """Start the job channel's listener (process 0, at server startup).
+    Without this, workers connecting at boot would exhaust their connect
+    deadline while the channel waits for the first job. No-op elsewhere."""
+    import jax
+
+    if is_multiprocess() and jax.process_index() == 0:
+        _get_channel()
+
+
 def dispatch(spec: Dict[str, Any]) -> None:
-    """Process-0 side: announce the next mesh job to every worker. No-op
-    single-process. Caller must then execute exactly the device-op
-    sequence `run_job` executes for this spec."""
+    """Process-0 side: announce the next mesh job to every worker and
+    rendezvous on their readiness. No-op single-process. Caller must then
+    execute exactly the device-op sequence `run_job` executes for this
+    spec."""
     if not is_multiprocess():
         return
-    _get_channel().send(spec)
+    _get_channel().dispatch(spec)
 
 
 class dispatch_guard:
@@ -144,12 +231,41 @@ class dispatch_guard:
 
 # -- worker side -------------------------------------------------------------
 
-def run_build_job(store, runtime, spec: Dict[str, Any]) -> None:
-    """Execute a model-build job's device-op sequence, mirroring
-    ``ModelBuilder.build``'s per-classifier compute exactly (fit →
+def jsonable_state(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Preprocessing state → JSON-safe (numpy scalars/arrays → Python).
+    Python's json round-trips floats exactly (repr), so a worker applying
+    the deserialized state reproduces process 0's design matrix
+    bit-for-bit."""
+    import numpy as np
+
+    def conv(v):
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, np.ndarray):
+            return [conv(x) for x in v.tolist()]
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        return v
+
+    return conv(state)
+
+
+def prep_build_job(store, runtime, spec: Dict[str, Any]):
+    """Host-side prep for a build job; returns the device-op callable.
+
+    Mirrors ``ModelBuilder.build``'s per-classifier compute exactly (fit →
     predict_proba with the same shapes, same order). Host-only work
     (persistence, prediction datasets, metrics) is process-0 business and
-    is skipped here."""
+    is skipped here. The spec pins process 0's snapshot: its row counts,
+    fitted preprocessing state, and feature fields — a concurrent ingest
+    commit between its save and this load may have appended rows or
+    shifted stats, and any divergence would either wedge the collectives
+    (shape mismatch) or silently assemble inconsistent global arrays.
+    Rows only ever append, so truncating to the pinned counts reproduces
+    the snapshot prefix; the pinned state makes the values identical.
+    """
     from learningorchestra_tpu.models.registry import get_trainer
     from learningorchestra_tpu.ops import preprocess
 
@@ -158,14 +274,12 @@ def run_build_job(store, runtime, spec: Dict[str, Any]) -> None:
     steps = spec.get("steps") or ()
     label = spec["label"]
     hparams = spec.get("hparams") or {}
+    state = spec.get("state")
+    ff = spec.get("feature_fields")
     X_train, y_train, ff, state = preprocess.design_matrix(
-        train_ds, label, steps)
+        train_ds, label, steps, state=state, feature_fields=ff)
     X_test, y_test, _, _ = preprocess.design_matrix(
         test_ds, label, steps, state=state, feature_fields=ff)
-    # The spec pins process 0's snapshot: an ingest commit between its
-    # save and this load may have appended rows, and a shape mismatch
-    # would wedge every collective. Rows only ever append, so truncating
-    # reproduces the snapshot prefix.
     n_train, n_test = spec.get("n_train"), spec.get("n_test")
     if n_train is not None:
         if len(X_train) < n_train or len(X_test) < n_test:
@@ -178,18 +292,23 @@ def run_build_job(store, runtime, spec: Dict[str, Any]) -> None:
         y_test = y_test[:n_test] if y_test is not None else None
     num_classes = int(max(int(y_train.max()) + 1,
                           2 if y_test is None else int(y_test.max()) + 1))
-    for c in spec["classifiers"]:
-        try:
-            trainer = get_trainer(c)
-            model = trainer(runtime, X_train, y_train, num_classes,
-                            **hparams.get(c, {}))
-            model.predict_proba(runtime, X_test)
-        except Exception:  # noqa: BLE001 — mirror process 0's per-model boundary
-            log.exception("worker fit %s failed", c)
+
+    def device_ops() -> None:
+        for c in spec["classifiers"]:
+            try:
+                trainer = get_trainer(c)
+                model = trainer(runtime, X_train, y_train, num_classes,
+                                **hparams.get(c, {}))
+                model.predict_proba(runtime, X_test)
+            except Exception:  # noqa: BLE001 — mirror per-model boundary
+                log.exception("worker fit %s failed", c)
+
+    return device_ops
 
 
-def run_predict_job(store, runtime, spec: Dict[str, Any]) -> None:
-    """Mirror ``ModelBuilder.predict``'s device ops for a re-served model."""
+def prep_predict_job(store, runtime, spec: Dict[str, Any]):
+    """Host-side prep mirroring ``ModelBuilder.predict``; returns the
+    device-op callable."""
     from learningorchestra_tpu.models.persistence import ModelRegistry
     from learningorchestra_tpu.ops import preprocess
 
@@ -207,7 +326,10 @@ def run_predict_job(store, runtime, spec: Dict[str, Any]) -> None:
                 f"worker sees fewer rows ({len(X)}) than the dispatched "
                 f"snapshot ({n}) — shared store out of sync")
         X = X[:n]
-    model.predict_proba(runtime, X)
+    return lambda: model.predict_proba(runtime, X)
+
+
+_PREPPERS = {"build": prep_build_job, "predict": prep_predict_job}
 
 
 def _connect_to_controller(timeout_s: float = 120.0) -> socket.socket:
@@ -225,38 +347,58 @@ def _connect_to_controller(timeout_s: float = 120.0) -> socket.socket:
 
 
 def worker_loop(store, runtime) -> None:
-    """Non-zero processes: block on the next job spec, execute its device
-    ops, repeat until shutdown. The store must point at the same (shared)
-    store_root process 0 persists into — the data plane that replaces the
-    reference's Mongo-as-shared-storage for Spark executors."""
+    """Non-zero processes: block on the next job spec, prep host-side
+    inputs, ack readiness, await ``go``, execute the device ops; repeat
+    until shutdown. The store must point at the same (shared) store_root
+    process 0 persists into — the data plane that replaces the reference's
+    Mongo-as-shared-storage for Spark executors."""
     import jax
 
     log.info("worker %d/%d entering SPMD loop",
              jax.process_index(), jax.process_count())
     sock = _connect_to_controller()
-    buf = b""
+    conn = _Conn(sock)
+
+    def reply(msg: Dict[str, Any]) -> None:
+        sock.sendall((json.dumps(msg) + "\n").encode("utf-8"))
+
     while True:
-        while b"\n" not in buf:
-            data = sock.recv(1 << 16)
-            if not data:
-                log.info("controller closed the job channel; exiting")
-                return
-            buf += data
-        line, buf = buf.split(b"\n", 1)
-        spec = json.loads(line.decode("utf-8"))
+        line = conn.recv_line(None)
+        if line is None:
+            log.info("controller closed the job channel; exiting")
+            return
+        spec = json.loads(line)
         op = spec.get("op")
         if op == "shutdown":
             log.info("worker %d shutting down", jax.process_index())
             return
-        try:
-            if op == "build":
-                run_build_job(store, runtime, spec)
-            elif op == "predict":
-                run_predict_job(store, runtime, spec)
-            else:
-                log.error("unknown job op: %r", op)
-        except Exception:  # noqa: BLE001 — keep the loop alive
-            log.exception("worker job %r failed", op)
+        if op in ("go", "abort"):
+            continue  # stray control line from an aborted round
+        prepper = _PREPPERS.get(op)
+        device_ops = None
+        if prepper is None:
+            reply({"status": "fail", "error": f"unknown job op: {op!r}"})
+        else:
+            try:
+                device_ops = prepper(store, runtime, spec)
+                reply({"status": "ready"})
+            except Exception as exc:  # noqa: BLE001 — nack, keep loop alive
+                log.exception("worker prep for %r failed", op)
+                reply({"status": "fail",
+                       "error": f"{type(exc).__name__}: {exc}"})
+        # Await the controller's verdict for this round.
+        line = conn.recv_line(300.0)
+        if line is None:
+            log.info("controller lost mid-round; exiting")
+            return
+        verdict = json.loads(line).get("op")
+        if verdict == "go" and device_ops is not None:
+            try:
+                device_ops()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                log.exception("worker device ops for %r failed", op)
+        elif verdict == "shutdown":
+            return
 
 
 def require_single_process(what: str) -> None:
@@ -272,7 +414,4 @@ def require_single_process(what: str) -> None:
 def shutdown_workers() -> None:
     """Process 0: release every worker from its loop (server shutdown)."""
     if is_multiprocess():
-        try:
-            _get_channel().send({"op": "shutdown"})
-        except TimeoutError:
-            pass
+        _get_channel().broadcast({"op": "shutdown"})

@@ -48,6 +48,7 @@ def main() -> None:
 
     from learningorchestra_tpu.parallel import spmd
 
+    spmd.ensure_channel()  # workers connect at boot; listener must exist
     app = App(settings, recover=not args.no_recover)
     print(f"learningorchestra_tpu serving on {args.host}:{args.port} "
           f"(devices: {distributed.process_info()['devices']})", flush=True)

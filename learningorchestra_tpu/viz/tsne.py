@@ -21,9 +21,11 @@ hostile to XLA, so this is a re-design around what the MXU does well:
   momentum 0.8 with per-coordinate gains, as in van der Maaten's reference
   implementation.
 
-Single-chip today (MNIST-60k fits one chip's HBM thousands of times over);
-multi-chip would row-shard the tile scan and all-gather the 2-D embedding
-each iteration.
+Multi-chip: the repulsion — the embed's entire asymptotic cost — row-shards
+over the mesh data axis (each shard computes its row range against the
+replicated (n, 2) embedding; Z partials psum over ICI and force rows
+all-gather back), so a v5e-8 splits the O(n²) term 8 ways. The kNN/
+calibration front-end stays replicated (it runs once, not per iteration).
 """
 
 from __future__ import annotations
@@ -34,9 +36,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as Pspec
 
 from learningorchestra_tpu.ops import pallas_kernels
-from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 from learningorchestra_tpu.viz.pca import pca_embed
 
 _TILE = 1024
@@ -101,43 +104,84 @@ def _calibrate(d2k, perplexity):
     return P                                       # (n, k) row-normalized
 
 
-@partial(jax.jit, static_argnames=("tile", "use_pallas"), donate_argnums=(0,))
+def _rep_rows_scan(Yq, vq, Y, valid, offset, *, tile):
+    """Pure-XLA repulsion for global rows [offset, offset+len(Yq)) against
+    all columns — the scan twin of the Pallas ``tsne_repulsion_rows``."""
+    n = Y.shape[0]
+    nq = Yq.shape[0]
+    ysq = (Y * Y).sum(axis=1)
+
+    def rep_block(carry, i):
+        Z_acc, F = carry
+        rows = jax.lax.dynamic_slice_in_dim(Yq, i * tile, tile)
+        vrows = jax.lax.dynamic_slice_in_dim(vq, i * tile, tile)
+        rsq = (rows * rows).sum(axis=1)
+        d2 = rsq[:, None] + ysq[None, :] - 2.0 * (rows @ Y.T)
+        q = 1.0 / (1.0 + d2)
+        row_ids = offset + i * tile + jnp.arange(tile)
+        pair_valid = (valid[None, :] * vrows[:, None]
+                      * (jnp.arange(n)[None, :] != row_ids[:, None]))
+        q = q * pair_valid
+        Z_acc = Z_acc + q.sum()
+        # repulsive force numerator: sum_j q² (yi − yj)
+        q2 = q * q
+        f = rows * q2.sum(axis=1, keepdims=True) - q2 @ Y
+        F = jax.lax.dynamic_update_slice_in_dim(F, f, i * tile, axis=0)
+        return (Z_acc, F), None
+
+    (Z, F), _ = jax.lax.scan(
+        rep_block, (jnp.float32(0.0), jnp.zeros((nq, 2), Y.dtype)),
+        jnp.arange(nq // tile))
+    return Z, F
+
+
+def _repulsion(Y, valid, *, tile, use_pallas, mesh):
+    """(Z, F) over all pairs; row-sharded across the mesh data axis when
+    it has >1 device: each shard computes its row range against the full
+    (replicated, n×2 — tiny) embedding, Z partials psum over ICI, and the
+    force rows all-gather back to replicated. This distributes the O(n²)
+    term, the embed's entire asymptotic cost (the reference's tsne is
+    single-core sklearn, reference tsne.py:74-102)."""
+    n = Y.shape[0]
+    ktile = min(tile, pallas_kernels.TILE)
+    P_data = 1 if mesh is None else mesh.shape[DATA_AXIS]
+    if P_data == 1:
+        if use_pallas:
+            return pallas_kernels.tsne_repulsion(Y, valid, tile=ktile)
+        return _rep_rows_scan(Y, valid, Y, valid, 0, tile=tile)
+
+    nloc = n // P_data
+
+    def shard_fn(Yr, vr):
+        k = jax.lax.axis_index(DATA_AXIS)
+        off = k * nloc
+        Yq = jax.lax.dynamic_slice_in_dim(Yr, off, nloc)
+        vq = jax.lax.dynamic_slice_in_dim(vr, off, nloc)
+        if use_pallas:
+            Zp, Fp = pallas_kernels.tsne_repulsion_rows(
+                Yq, vq, Yr, vr, off, tile=ktile)
+        else:
+            Zp, Fp = _rep_rows_scan(Yq, vq, Yr, vr, off, tile=tile)
+        return (jax.lax.psum(Zp, DATA_AXIS),
+                jax.lax.all_gather(Fp, DATA_AXIS, axis=0, tiled=True))
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(Pspec(), Pspec()),
+        out_specs=(Pspec(), Pspec()), check_vma=False,
+    )(Y, valid)
+
+
+@partial(jax.jit, static_argnames=("tile", "use_pallas", "mesh"),
+         donate_argnums=(0,))
 def _step(Y, vel, gains, P, idx, n_valid, exaggeration, eta, momentum, *,
-          tile, use_pallas=False):
+          tile, use_pallas=False, mesh=None):
     n = Y.shape[0]
     valid = (jnp.arange(n) < n_valid).astype(jnp.float32)
 
-    # --- exact repulsion: tiled full-pairwise over the 2-D embedding -------
-    if use_pallas:
-        # Fused Pallas kernel: whole block pipeline stays in VMEM
-        # (ops/pallas_kernels.py); semantics identical to the scan below.
-        # The kernel's grid tile is capped at its VMEM-sized TILE — n is
-        # padded to a multiple of the (>=) scan tile, so divisibility holds.
-        Z, Frep = pallas_kernels.tsne_repulsion(
-            Y, valid, tile=min(tile, pallas_kernels.TILE))
-    else:
-        ysq = (Y * Y).sum(axis=1)
-
-        def rep_block(carry, i):
-            Z_acc, F = carry
-            rows = jax.lax.dynamic_slice_in_dim(Y, i * tile, tile)
-            rsq = jax.lax.dynamic_slice_in_dim(ysq, i * tile, tile)
-            d2 = rsq[:, None] + ysq[None, :] - 2.0 * (rows @ Y.T)
-            q = 1.0 / (1.0 + d2)
-            row_ids = i * tile + jnp.arange(tile)
-            pair_valid = (valid[None, :] * valid[row_ids][:, None]
-                          * (jnp.arange(n)[None, :] != row_ids[:, None]))
-            q = q * pair_valid
-            Z_acc = Z_acc + q.sum()
-            # repulsive force numerator: sum_j q² (yi − yj)
-            q2 = q * q
-            f = rows * q2.sum(axis=1, keepdims=True) - q2 @ Y
-            F = jax.lax.dynamic_update_slice_in_dim(F, f, i * tile, axis=0)
-            return (Z_acc, F), None
-
-        (Z, Frep), _ = jax.lax.scan(
-            rep_block, (jnp.float32(0.0), jnp.zeros_like(Y)),
-            jnp.arange(n // tile))
+    # --- exact repulsion: tiled full-pairwise over the 2-D embedding,
+    # row-sharded over the mesh data axis when available ---------------------
+    Z, Frep = _repulsion(Y, valid, tile=tile, use_pallas=use_pallas,
+                         mesh=mesh)
     Z = jnp.maximum(Z, 1e-12)
 
     # --- sparse symmetric attraction over kNN edges ------------------------
@@ -178,7 +222,14 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
     if d > pca_dims:
         X = pca_embed(runtime, X, k=pca_dims)  # standard PCA-50 front end
     tile = min(tile, 1 << max(3, (n - 1).bit_length() - 1))
-    Xp, n_valid = _pad_rows(X, tile)
+    # Row-shard the O(n²) repulsion across the mesh data axis when each
+    # shard still gets at least one full tile of rows; smaller problems
+    # run single-device (they are sub-second anyway).
+    mesh = runtime.mesh
+    P_data = mesh.shape[DATA_AXIS]
+    shard = P_data > 1 and n >= P_data * tile
+    pad_to = tile * P_data if shard else tile
+    Xp, n_valid = _pad_rows(X, pad_to)
     k = min(int(3 * perplexity), n - 1)
 
     d2k, idx = _knn(jnp.asarray(Xp), k=k, tile=tile)
@@ -197,6 +248,7 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
     # The fused kernel wants lane-width (≥128) tiles; tiny datasets use the
     # XLA scan path, which is compile-time-cheaper there anyway.
     use_pallas = bool(runtime.cfg.use_pallas) and tile >= 128
+    step_mesh = mesh if shard else None
 
     for it in range(iters):
         exag = 12.0 if it < exaggeration_iters else 1.0
@@ -204,5 +256,5 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
         Y, vel, gains = _step(Y, vel, gains, P, idx, nv,
                               jnp.float32(exag), jnp.float32(eta),
                               jnp.float32(momentum), tile=tile,
-                              use_pallas=use_pallas)
+                              use_pallas=use_pallas, mesh=step_mesh)
     return np.asarray(Y)[:n_valid]

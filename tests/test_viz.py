@@ -65,6 +65,51 @@ def test_tsne_separates_clusters(runtime):
     assert _silhouette_like(emb, y) > 2.0
 
 
+def test_tsne_sharded_repulsion_matches_single_device(runtime):
+    """Row-sharding the repulsion over the 8-device data axis must
+    reproduce the single-device (Z, F) and step output (same math, only
+    reassociated across shards)."""
+    import jax.numpy as jnp
+
+    from learningorchestra_tpu.viz import tsne as tz
+
+    rng = np.random.default_rng(0)
+    P_data = runtime.mesh.shape["data"]
+    tile = 64
+    n = tile * P_data * 2                    # 2 row tiles per shard
+    n_valid = n - 37                         # exercise padding masks
+    Y = jnp.asarray(rng.normal(size=(n, 2)), jnp.float32)
+    valid = (jnp.arange(n) < n_valid).astype(jnp.float32)
+
+    Z1, F1 = tz._repulsion(Y, valid, tile=tile, use_pallas=False, mesh=None)
+    Z8, F8 = tz._repulsion(Y, valid, tile=tile, use_pallas=False,
+                           mesh=runtime.mesh)
+    assert np.isclose(float(Z1), float(Z8), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(F1), np.asarray(F8),
+                               rtol=1e-4, atol=1e-6)
+    # Pallas (interpreter on CPU) sharded path agrees too.
+    Zp, Fp = tz._repulsion(Y, valid, tile=tile, use_pallas=True,
+                           mesh=runtime.mesh)
+    assert np.isclose(float(Z1), float(Zp), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(F1), np.asarray(Fp),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_tsne_sharded_embed_separates_clusters(runtime):
+    """Full embed with the sharded step (n large enough to trigger
+    row-sharding at a small tile) still separates clusters."""
+    X, y = _clusters(n_per=360, d=8, classes=3)   # n=1080 ≥ 8·128
+    emb = tsne_embed(runtime, X, perplexity=15, iters=120,
+                     exaggeration_iters=40, seed=0, tile=128)
+    assert emb.shape == (len(X), 2)
+    centers = np.stack([emb[y == c].mean(axis=0) for c in range(3)])
+    spread = max(np.linalg.norm(emb[y == c] - centers[c], axis=1).mean()
+                 for c in range(3))
+    dists = [np.linalg.norm(centers[a] - centers[b])
+             for a in range(3) for b in range(a + 1, 3)]
+    assert min(dists) > 2.0 * spread
+
+
 def test_create_embedding_images(store, runtime, cfg):
     X, y = _clusters(n_per=30)
     store.create("viz_src", columns={
