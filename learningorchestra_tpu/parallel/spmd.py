@@ -63,21 +63,29 @@ class _Conn:
         self.sock = sock
         self.buf = b""
 
-    def recv_line(self, timeout: Optional[float]) -> Optional[str]:
-        """One JSON line, or None on EOF/timeout/error."""
+    def recv_line(self, timeout: Optional[float]):
+        """(status, line): ("ok", str) | ("timeout", None) | ("eof", None).
+
+        Timeout and EOF are distinct on purpose: a slow worker (still
+        prepping a big job) must not be treated as a dead one."""
         self.sock.settimeout(timeout)
         try:
             while b"\n" not in self.buf:
                 data = self.sock.recv(1 << 16)
                 if not data:
-                    return None
+                    return "eof", None
                 self.buf += data
+        except (TimeoutError, socket.timeout):
+            return "timeout", None
         except OSError:
-            return None
+            return "eof", None
         finally:
-            self.sock.settimeout(None)
+            try:
+                self.sock.settimeout(None)
+            except OSError:
+                pass
         line, self.buf = self.buf.split(b"\n", 1)
-        return line.decode("utf-8")
+        return "ok", line.decode("utf-8")
 
 
 class _JobChannel:
@@ -92,6 +100,10 @@ class _JobChannel:
     def __init__(self, n_workers: int):
         self.n_workers = n_workers
         self._lock = threading.Lock()
+        #: Serializes all socket writes: a shutdown broadcast racing an
+        #: in-flight dispatch must not interleave bytes within a line.
+        self._wlock = threading.Lock()
+        self._round = 0
         self._conns: List[_Conn] = []
         _, port = _job_addr()
         self._srv = socket.create_server(("", port))
@@ -124,51 +136,71 @@ class _JobChannel:
 
     def _sendall(self, conns: List[_Conn], msg: Dict[str, Any]) -> None:
         data = (json.dumps(msg) + "\n").encode("utf-8")
-        for conn in conns:
-            try:
-                conn.sock.sendall(data)
-            except OSError:
-                self._drop(conn)
+        with self._wlock:
+            for conn in conns:
+                try:
+                    conn.sock.sendall(data)
+                except OSError:
+                    self._drop(conn)
 
-    def dispatch(self, spec: Dict[str, Any],
-                 timeout_s: float = 120.0) -> None:
-        """Two-phase fan-out: send the spec, wait for every worker's
-        ``ready`` ack (host-side prep done — datasets loaded, shapes
-        agreed), then release them with ``go``. Any failed/missing ack
-        aborts the round on every worker and raises, so process 0 never
-        enters a collective some worker will not join. (A failure *after*
-        go — mid-collective — still wedges; that is inherent to
-        collectives without timeouts and surfaces at pod supervision.)"""
-        deadline = time.time() + timeout_s
+    def _read_ack(self, conn: _Conn, rnd: int, deadline: float):
+        """This round's ack from one worker, skipping stale acks from
+        aborted earlier rounds. Returns (status, ack_dict|None)."""
+        while True:
+            status, line = conn.recv_line(max(1.0, deadline - time.time()))
+            if status != "ok":
+                return status, None
+            try:
+                ack = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if ack.get("round") == rnd:
+                return "ok", ack
+            # stale ack from an earlier aborted round — discard
+
+    def dispatch(self, spec: Dict[str, Any], connect_timeout_s: float = 60.0,
+                 prep_timeout_s: float = 600.0) -> None:
+        """Two-phase fan-out: send the (round-stamped) spec, wait for every
+        worker's ``ready`` ack (host-side prep done — datasets loaded,
+        shapes agreed), then release them with ``go``. Any failed/missing
+        ack aborts the round on every worker and raises, so process 0
+        never enters a collective some worker will not join. A *timed-out*
+        worker is not dropped — it may just be slow, and its stale ack is
+        discarded by round id on the next dispatch; only EOF (the process
+        died — it cannot rejoin a running pod) removes a connection. (A
+        failure *after* go — mid-collective — still wedges; that is
+        inherent to collectives without timeouts and surfaces at pod
+        supervision.)"""
+        deadline = time.time() + connect_timeout_s
         while len(self._live()) < self.n_workers:
             if time.time() > deadline:
-                self._sendall(self._live(), {"op": "abort"})
                 raise TimeoutError(
                     f"only {len(self._live())}/{self.n_workers} workers "
                     "connected to the job channel")
             time.sleep(0.05)
+        with self._lock:
+            self._round += 1
+            rnd = self._round
         conns = self._live()[:self.n_workers]
-        self._sendall(conns, spec)
+        self._sendall(conns, dict(spec, round=rnd))
+        deadline = time.time() + prep_timeout_s
         failures = []
         for conn in conns:
-            line = conn.recv_line(max(1.0, deadline - time.time()))
-            ack = None
-            if line is not None:
-                try:
-                    ack = json.loads(line)
-                except json.JSONDecodeError:
-                    pass
-            if ack is None:
+            status, ack = self._read_ack(conn, rnd, deadline)
+            if status == "eof":
                 self._drop(conn)
-                failures.append("worker connection lost before ack")
+                failures.append("worker died before ack")
+            elif status == "timeout":
+                failures.append(
+                    f"worker ack timed out after {prep_timeout_s:.0f}s")
             elif ack.get("status") != "ready":
                 failures.append(ack.get("error", "worker prep failed"))
         if failures:
-            self._sendall(self._live(), {"op": "abort"})
+            self._sendall(self._live(), {"op": "abort", "round": rnd})
             raise RuntimeError(
                 f"SPMD dispatch aborted ({len(failures)} worker(s)): "
                 + "; ".join(failures[:3]))
-        self._sendall(conns, {"op": "go"})
+        self._sendall(conns, {"op": "go", "round": rnd})
 
     def broadcast(self, msg: Dict[str, Any]) -> None:
         """Fire-and-forget control message (shutdown) — no ack round."""
@@ -359,16 +391,24 @@ def worker_loop(store, runtime) -> None:
     sock = _connect_to_controller()
     conn = _Conn(sock)
 
-    def reply(msg: Dict[str, Any]) -> None:
-        sock.sendall((json.dumps(msg) + "\n").encode("utf-8"))
+    def reply(msg: Dict[str, Any]) -> bool:
+        """Send an ack; False when the controller is gone (socket closed
+        after an abort, controller restart) — exit cleanly, not by
+        traceback."""
+        try:
+            sock.sendall((json.dumps(msg) + "\n").encode("utf-8"))
+            return True
+        except OSError:
+            return False
 
     while True:
-        line = conn.recv_line(None)
-        if line is None:
+        status, line = conn.recv_line(None)
+        if status != "ok":
             log.info("controller closed the job channel; exiting")
             return
         spec = json.loads(line)
         op = spec.get("op")
+        rnd = spec.get("round")
         if op == "shutdown":
             log.info("worker %d shutting down", jax.process_index())
             return
@@ -377,18 +417,24 @@ def worker_loop(store, runtime) -> None:
         prepper = _PREPPERS.get(op)
         device_ops = None
         if prepper is None:
-            reply({"status": "fail", "error": f"unknown job op: {op!r}"})
+            ok = reply({"status": "fail", "round": rnd,
+                        "error": f"unknown job op: {op!r}"})
         else:
             try:
                 device_ops = prepper(store, runtime, spec)
-                reply({"status": "ready"})
+                ok = reply({"status": "ready", "round": rnd})
             except Exception as exc:  # noqa: BLE001 — nack, keep loop alive
                 log.exception("worker prep for %r failed", op)
-                reply({"status": "fail",
-                       "error": f"{type(exc).__name__}: {exc}"})
-        # Await the controller's verdict for this round.
-        line = conn.recv_line(300.0)
-        if line is None:
+                ok = reply({"status": "fail", "round": rnd,
+                            "error": f"{type(exc).__name__}: {exc}"})
+        if not ok:
+            log.info("controller lost while acking; exiting")
+            return
+        # Await the controller's verdict for this round (blocking: the
+        # controller may legitimately spend minutes collecting other
+        # workers' acks; its death surfaces as EOF).
+        status, line = conn.recv_line(None)
+        if status != "ok":
             log.info("controller lost mid-round; exiting")
             return
         verdict = json.loads(line).get("op")
