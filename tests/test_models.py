@@ -65,6 +65,24 @@ def test_unknown_classifier():
         get_trainer("xgboost")
 
 
+def test_lr_device_stats_avoid_cancellation(runtime):
+    """Regression: standardization stats computed on-device must use the
+    two-pass form — E[x²]−E[x]² in f32 collapses for |mean| ≫ std (e.g.
+    a year column), which would silently feed the solver unstandardized
+    features."""
+    from learningorchestra_tpu.models import logistic
+
+    rng = np.random.default_rng(0)
+    n = 4096
+    X = np.stack([rng.normal(2.0e4, 1.0, n),       # year/price-like
+                  rng.normal(0.0, 3.0, n)], axis=1).astype(np.float32)
+    X_dev, nn = runtime.shard_rows(X)
+    mu, sigma = logistic._device_stats(
+        X_dev, runtime.replicate(np.int32(nn)), mesh=runtime.mesh)
+    np.testing.assert_allclose(np.asarray(mu), X.mean(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(sigma), X.std(0), rtol=2e-2)
+
+
 def test_lr_matches_sklearn(runtime):
     from sklearn.linear_model import LogisticRegression
 
