@@ -1,0 +1,116 @@
+"""At-scale out-of-core pipeline demo (the Criteo-row mechanics measured).
+
+Generates a multi-GB CSV on disk, ingests it under a RAM budget a
+fraction of its size, then runs the streaming histogram + projection
+pipeline — the BASELINE.md Criteo-1TB config's mechanics at a scale this
+rig's disk allows. Reports wall-clock and the resident-memory ceiling the
+catalog observed.
+
+Usage: python benchmarks/bench_outofcore.py [gb] [budget_mb]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def write_csv(path: str, target_bytes: int) -> int:
+    """Deterministic wide-ish CSV of ~target_bytes; returns row count."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    rows = 0
+    with open(path, "w", buffering=1 << 22) as f:
+        f.write("id,cat,flag,v0,v1,v2,v3,label\n")
+        chunk = 200_000
+        while f.tell() < target_bytes:
+            ids = np.arange(rows, rows + chunk)
+            cat = rng.integers(0, 1000, chunk)
+            flag = rng.integers(0, 2, chunk)
+            V = rng.normal(size=(chunk, 4))
+            lab = rng.integers(0, 2, chunk)
+            lines = "\n".join(
+                f"{ids[i]},c{cat[i]},{flag[i]},{V[i,0]:.5f},{V[i,1]:.5f},"
+                f"{V[i,2]:.5f},{V[i,3]:.5f},{lab[i]}"
+                for i in range(chunk))
+            f.write(lines + "\n")
+            rows += chunk
+    return rows
+
+
+def main(gb: float = 4.0, budget_mb: int = 512):
+    from learningorchestra_tpu.config import Settings
+
+    root = tempfile.mkdtemp(prefix="lo_ooc_")
+    cfg = Settings()
+    cfg.store_root = os.path.join(root, "store")
+    cfg.persist = True
+    cfg.ram_budget_mb = budget_mb
+    csv_path = os.path.join(root, "big.csv")
+    try:
+        _run(cfg, csv_path, gb, budget_mb)
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _run(cfg, csv_path, gb, budget_mb):
+    from learningorchestra_tpu.catalog.ingest import ingest_csv_url
+    from learningorchestra_tpu.catalog.store import DatasetStore
+    from learningorchestra_tpu.ops.histogram import create_histogram
+    from learningorchestra_tpu.ops.projection import create_projection
+    from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+    t0 = time.time()
+    rows = write_csv(csv_path, int(gb * (1 << 30)))
+    print(json.dumps({"bench": "outofcore.gen_csv",
+                      "seconds": round(time.time() - t0, 1),
+                      "rows": rows, "gb": round(gb, 1)}), flush=True)
+
+    store = DatasetStore(cfg)
+    runtime = MeshRuntime(cfg)
+    store.create("big", url=csv_path)
+    t0 = time.time()
+    ingest_csv_url(store, "big", csv_path, cfg)
+    ds = store.get("big")
+    print(json.dumps({
+        "bench": "outofcore.ingest", "seconds": round(time.time() - t0, 1),
+        "rows": ds.num_rows, "data_mb": ds.data_bytes >> 20,
+        "resident_mb": ds.mem_bytes >> 20, "budget_mb": budget_mb,
+    }), flush=True)
+    assert ds.mem_bytes <= (budget_mb << 20) + ds.data_bytes // 10
+
+    t0 = time.time()
+    create_histogram(store, runtime, "big", "big_hist", ["cat", "flag"])
+    counts = store.read("big_hist", limit=1,
+                        query={"field": "flag"})[0]["counts"]
+    print(json.dumps({
+        "bench": "outofcore.histogram", "seconds": round(time.time() - t0, 1),
+        "flag_counts": {str(k): v for k, v in counts.items()},
+    }), flush=True)
+    assert sum(counts.values()) == ds.num_rows
+
+    t0 = time.time()
+    create_projection(store, "big", "big_proj", ["id", "v0", "label"])
+    proj = store.get("big_proj")
+    print(json.dumps({
+        "bench": "outofcore.projection",
+        "seconds": round(time.time() - t0, 1),
+        "rows": proj.num_rows, "resident_mb": proj.mem_bytes >> 20,
+    }), flush=True)
+    assert proj.num_rows == ds.num_rows
+    last = store.read("big_proj", skip=ds.num_rows - 1, limit=2)
+    assert last[-1]["id"] == ds.num_rows - 1
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 4.0,
+         int(sys.argv[2]) if len(sys.argv) > 2 else 512)

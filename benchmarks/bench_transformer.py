@@ -1,0 +1,74 @@
+"""Transformer train-step throughput (long-context tier, BASELINE "extra").
+
+The reference has no sequence models; the rebuild carries them as
+first-class capability (SURVEY §2 "not present" → TPU-idiomatic hooks):
+a dp×tp×sp transformer whose attention runs as a ring over the seq axis
+(parallel/ring_attention.py). This bench measures the single-chip
+train-step throughput of the classifier transformer (models/transformer.py)
+at a few shapes; multi-chip sharding is validated by the test suite and
+the driver's ``dryrun_multichip`` (2,2,2 mesh).
+
+Usage: python benchmarks/bench_transformer.py
+Prints one JSON line per config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bench(cfg_kw, batch, seq, iters=20):
+    import jax
+    import optax
+
+    from learningorchestra_tpu.models import transformer as tx
+    from learningorchestra_tpu.parallel.mesh import local_mesh
+
+    cfg = tx.TxConfig(max_len=seq, **cfg_kw)
+    mesh = local_mesh()
+    params = tx.shard_params(
+        tx.init_params(jax.random.PRNGKey(0), cfg), cfg, mesh)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    step = tx.make_train_step(cfg, mesh, opt)
+    rng = np.random.default_rng(0)
+    tokens = np.ascontiguousarray(
+        rng.integers(0, cfg.vocab, (batch, seq)).astype(np.int32))
+    labels = np.ascontiguousarray(
+        rng.integers(0, cfg.n_classes, (batch,)).astype(np.int32))
+
+    params, opt_state, loss = step(params, opt_state, tokens, labels)
+    jax.block_until_ready(loss)
+    t0 = time.time()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+    float(loss)  # real completion barrier
+    dt = (time.time() - t0) / iters
+    print(json.dumps({
+        "bench": "transformer.train_step",
+        "d_model": cfg.d_model, "layers": cfg.n_layers, "seq": seq,
+        "batch": batch, "step_s": round(dt, 4),
+        "tokens_per_s": int(batch * seq / dt),
+        "loss": round(float(loss), 4),
+    }), flush=True)
+
+
+def main():
+    small = dict(d_model=256, n_heads=8, n_layers=4, d_ff=1024)
+    large = dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048)
+    bench(small, batch=32, seq=1024)
+    bench(large, batch=16, seq=2048)
+    bench(large, batch=4, seq=8192)
+    bench(dict(large, remat=True), batch=1, seq=32768, iters=5)
+
+
+if __name__ == "__main__":
+    main()

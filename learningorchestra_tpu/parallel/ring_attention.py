@@ -49,12 +49,24 @@ def _online_block(q, k_blk, v_blk, o, m, l, mask):
     return o, m_new, l
 
 
-def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
+#: Keys/values processed per online-softmax fold. Bounds the score
+#: transient at (B, H, T_local, KV_BLOCK) regardless of sequence length —
+#: the single-device/local-block analogue of flash attention's tiling
+#: (without it, an 8k-seq single-chip step materialized 8 GB score
+#: tensors per layer and OOM'd a 16 GB chip).
+KV_BLOCK = 1024
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                   kv_block: int = KV_BLOCK):
     """Exact multi-head attention with sequence sharded over ``axis_name``.
 
     Per-shard shapes (inside shard_map): q, k, v — (B, T_local, H, D).
-    Returns (B, T_local, H, D). With a size-1 axis this degrades to plain
-    single-device attention (the mask path still applies causality).
+    Returns (B, T_local, H, D). With a size-1 axis this degrades to
+    blockwise (flash-style) single-device attention: each ring hop's
+    K/V block additionally folds through the online softmax in
+    ``kv_block``-sized chunks, so memory stays O(T·kv_block) at any
+    length (ragged tails pad the block and mask the padded keys).
     """
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -70,19 +82,47 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
     l = qf[..., 0] * 0.0
 
     q_pos = my_idx * Tq + jnp.arange(Tq)
+    chunk = min(kv_block, Tk)
+    n_chunks = -(-Tk // chunk)
+    Tk_pad = n_chunks * chunk  # ragged tails pad; padded keys are masked
 
     def fold(o, m, l, k_blk, v_blk, t):
-        if causal:
-            # The block held at step t originated at ring position
-            # (my_idx - t) mod P; its keys carry that global offset.
-            src = (my_idx - t) % axis_size
-            k_pos = src * Tk + jnp.arange(Tk)
-            mask = jnp.where(k_pos[None, :] > q_pos[:, None],
-                             -jnp.inf, 0.0).astype(jnp.float32)
-        else:
+        # The block held at step t originated at ring position
+        # (my_idx - t) mod P; its keys carry that global offset.
+        src = (my_idx - t) % axis_size
+        if Tk_pad != Tk:
+            pad = ((0, 0), (0, Tk_pad - Tk), (0, 0), (0, 0))
+            k_blk = jnp.pad(k_blk, pad)
+            v_blk = jnp.pad(v_blk, pad)
+
+        def fold_chunk(carry, ci):
+            o, m, l = carry
+            kc = jax.lax.dynamic_slice_in_dim(k_blk, ci * chunk, chunk,
+                                              axis=1)
+            vc = jax.lax.dynamic_slice_in_dim(v_blk, ci * chunk, chunk,
+                                              axis=1)
+            k_local = ci * chunk + jnp.arange(chunk)
             mask = None
-        return _online_block(qf, k_blk.astype(jnp.float32),
-                             v_blk.astype(jnp.float32), o, m, l, mask)
+            if Tk_pad != Tk:
+                mask = jnp.where(k_local[None, :] >= Tk, -jnp.inf,
+                                 0.0).astype(jnp.float32) * jnp.ones(
+                                     (Tq, 1), jnp.float32)
+            if causal:
+                k_pos = src * Tk + k_local
+                cm = jnp.where(k_pos[None, :] > q_pos[:, None],
+                               -jnp.inf, 0.0).astype(jnp.float32)
+                mask = cm if mask is None else mask + cm
+            o, m, l = _online_block(qf, kc.astype(jnp.float32),
+                                    vc.astype(jnp.float32), o, m, l, mask)
+            return (o, m, l), None
+
+        if n_chunks == 1:
+            (o, m, l), _ = fold_chunk((o, m, l), 0)
+        else:
+            (o, m, l), _ = jax.lax.scan(
+                jax.checkpoint(fold_chunk), (o, m, l),
+                jnp.arange(n_chunks))
+        return o, m, l
 
     # Own block first, then rotate-then-fold for the remaining P-1 hops —
     # no wasted final ppermute whose result would be discarded.
