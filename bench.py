@@ -16,10 +16,11 @@ extrapolated linearly (conservative for trees): 108.7 CPU-seconds at 1.1M
 ≥10x (BASELINE.json).
 
 Steady-state timing: one warmup sweep populates XLA's compilation cache
-(also persisted to disk so repeated bench runs stay warm), then the
-measured sweep runs — matching how the long-lived server process actually
-behaves (the reference's published 41.87 s NaiveBayes fit likewise
-excludes Spark cluster startup).
+(also persisted to disk so repeated bench runs stay warm), then three
+measured sweeps run and the median is reported (the tunneled test chip
+adds run-to-run jitter) — matching how the long-lived server process
+actually behaves (the reference's published 41.87 s NaiveBayes fit
+likewise excludes Spark cluster startup).
 """
 
 from __future__ import annotations
@@ -79,18 +80,25 @@ def main() -> None:
     # warmup (compile + host->device transfer)
     mb.build("bench_train", "bench_test", "warm", classifiers, "label")
 
-    t0 = time.time()
-    reports = mb.build("bench_train", "bench_test", "bench", classifiers,
-                       "label")
-    elapsed = time.time() - t0
-
-    bad = [r.kind for r in reports if "error" in r.metrics]
-    assert not bad, f"failed fits: {bad}"
-    # All five families must actually learn the workload (guards against a
-    # fast-but-broken fit gaming the wall-clock).
-    accs = {r.kind: round(r.metrics.get("accuracy", 0.0), 4)
-            for r in reports}
-    assert all(a > 0.65 for a in accs.values()), accs
+    # Median of 3 measured sweeps: the tunneled test chip adds seconds of
+    # run-to-run jitter that a single sample would bake into the record.
+    times = []
+    all_accs = []
+    for i in range(3):
+        t0 = time.time()
+        reports = mb.build("bench_train", "bench_test", f"bench{i}",
+                           classifiers, "label")
+        times.append(time.time() - t0)
+        bad = [r.kind for r in reports if "error" in r.metrics]
+        assert not bad, f"failed fits: {bad}"
+        all_accs.append({r.kind: round(r.metrics.get("accuracy", 0.0), 4)
+                         for r in reports})
+    elapsed = sorted(times)[1]
+    # Every sweep's five families must actually learn the workload (guards
+    # against a fast-but-broken fit gaming the wall-clock).
+    for accs in all_accs:
+        assert all(a > 0.65 for a in accs.values()), all_accs
+    accs = all_accs[-1]
     print(json.dumps({
         "metric": "model_builder 5-classifier sweep wall-clock "
                   "(HIGGS-11M, steady-state; accs "
