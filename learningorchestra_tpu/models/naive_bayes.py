@@ -10,11 +10,17 @@ than the reference's multinomial event model because stored datasets carry
 signed continuous features, which multinomial NB cannot ingest without a
 lossy shift; metrics on the reference's own Titanic workload are comparable
 (see tests/test_models.py parity suite).
+
+For strict reference parity, ``event_model="multinomial"`` fits the
+reference's exact event model (count-likelihood with Laplace smoothing,
+as pyspark's NaiveBayes defaults) — valid only for non-negative features,
+which it validates up front.
 """
 
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,28 +32,37 @@ from learningorchestra_tpu.parallel.mesh import MeshRuntime
 _VAR_FLOOR = 1e-6
 
 
+def _class_stats(y, n, n_valid, num_classes):
+    """Masked per-class machinery shared by both event models:
+    (onehot_T, counts, log_prior, mask). One-hot built transposed (C, n)
+    — the long row axis sits in lanes; an (n, C<128) layout would
+    lane-pad to 128 columns (GBs at 11M rows)."""
+    mask = (jnp.arange(n) < n_valid).astype(jnp.float32)
+    classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
+    onehot_T = (y[None, :] == classes).astype(jnp.float32) * mask[None, :]
+    counts = onehot_T.sum(axis=1)                    # (C,)
+    prior = jnp.log(jnp.maximum(counts, 1.0)
+                    / jnp.maximum(counts.sum(), 1.0))
+    return onehot_T, counts, prior, mask
+
+
 @partial(jax.jit, static_argnames=("num_classes",))
 def _fit(X, y, n_valid, *, num_classes, smoothing):
     n, d = X.shape
-    mask = (jnp.arange(n) < n_valid).astype(jnp.float32)
+    onehot_T, counts, prior, mask = _class_stats(y, n, n_valid, num_classes)
     # Center features by their global mean before the moment matmuls:
     # E[x²]−E[x]² cancels catastrophically in float32 for unstandardized
     # large-magnitude features; on centered data both moments are O(var).
     total = jnp.maximum(mask.sum(), 1.0)
     center = (mask @ X) / total                      # (d,) global feature mean
     Xc = X - center[None, :]
-    # One-hot built transposed (C, n) — the long row axis sits in lanes;
-    # an (n, C<128) layout would lane-pad to 128 columns (GBs at 11M rows).
-    classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
-    onehot_T = (y[None, :] == classes).astype(jnp.float32) * mask[None, :]
-    counts = onehot_T.sum(axis=1)                    # (C,)
     sums = onehot_T @ Xc                             # (C, d) — MXU contraction
     sqsums = onehot_T @ (Xc * Xc)                    # (C, d)
     denom = jnp.maximum(counts, 1.0)[:, None]
     mean_c = sums / denom
     var = jnp.maximum(sqsums / denom - mean_c ** 2, _VAR_FLOOR) + smoothing
-    prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
-    return {"mean": mean_c + center[None, :], "var": var, "log_prior": prior}
+    return {"mean": mean_c + center[None, :], "var": var,
+            "log_prior": prior}
 
 
 @jax.jit
@@ -71,15 +86,57 @@ def _predict_proba(params, X):
     return jax.nn.softmax(loglik + log_prior[None], axis=-1)
 
 
+@partial(jax.jit, static_argnames=("num_classes",))
+def _fit_multinomial(X, y, n_valid, *, num_classes, alpha):
+    """The reference's exact event model: per-class feature-count sums
+    with Laplace smoothing (pyspark NaiveBayes' default multinomial,
+    reference model_builder.py:156) — one MXU contraction."""
+    n, d = X.shape
+    onehot_T, _, prior, _ = _class_stats(y, n, n_valid, num_classes)
+    Ncd = onehot_T @ X                               # (C, d)
+    theta = (jnp.log(Ncd + alpha)
+             - jnp.log(Ncd.sum(axis=1, keepdims=True) + alpha * d))
+    return {"theta": theta, "log_prior": prior}
+
+
+@jax.jit
+def _predict_multinomial(params, X):
+    loglik = X @ params["theta"].T + params["log_prior"][None]
+    return jax.nn.softmax(loglik, axis=-1)
+
+
 def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
         num_classes: int, seed: int = 0, *,
-        smoothing: float = 1e-3) -> TrainedModel:
-    X_dev, n = runtime.shard_rows(np.asarray(X, np.float32))
+        smoothing: Optional[float] = None,
+        event_model: str = "gaussian") -> TrainedModel:
+    # Per-event-model smoothing defaults: the knob means variance floor
+    # for gaussian (1e-3) but Laplace alpha for multinomial, where the
+    # reference's pyspark default is lambda = 1.0.
+    if smoothing is None:
+        smoothing = 1.0 if event_model == "multinomial" else 1e-3
+    X = np.asarray(X, np.float32)
+    if event_model == "multinomial" and X.size and float(X.min()) < 0.0:
+        raise ValueError(
+            "multinomial naive Bayes requires non-negative features "
+            "(counts); use the default gaussian event model for signed "
+            "continuous data")
+    X_dev, n = runtime.shard_rows(X)
     y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
-    params = _fit(X_dev, y_dev, runtime.replicate(np.int32(n)),
-                  num_classes=num_classes,
-                  smoothing=runtime.replicate(np.float32(smoothing)))
+    if event_model == "multinomial":
+        params = _fit_multinomial(
+            X_dev, y_dev, runtime.replicate(np.int32(n)),
+            num_classes=num_classes,
+            alpha=runtime.replicate(np.float32(max(smoothing, 1e-9))))
+        predict = _predict_multinomial
+    elif event_model == "gaussian":
+        params = _fit(X_dev, y_dev, runtime.replicate(np.int32(n)),
+                      num_classes=num_classes,
+                      smoothing=runtime.replicate(np.float32(smoothing)))
+        predict = _predict_proba
+    else:
+        raise ValueError(f"unknown nb event_model {event_model!r}")
     return TrainedModel(kind="nb", params=params,
-                        predict_proba_fn=_predict_proba,
+                        predict_proba_fn=predict,
                         num_classes=num_classes,
-                        hparams={"smoothing": smoothing})
+                        hparams={"smoothing": smoothing,
+                                 "event_model": event_model})

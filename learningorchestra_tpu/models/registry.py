@@ -50,7 +50,9 @@ def predictor_for(kind: str, hparams: Dict) -> Callable:
     if kind == "lr":
         return logistic._predict_proba
     if kind == "nb":
-        return naive_bayes._predict_proba
+        return (naive_bayes._predict_multinomial
+                if hparams.get("event_model") == "multinomial"
+                else naive_bayes._predict_proba)
     if kind == "mlp":
         return mlp._predict_proba
     raise ValueError(f"no predictor for classifier kind {kind!r}")

@@ -65,6 +65,37 @@ def test_unknown_classifier():
         get_trainer("xgboost")
 
 
+def test_nb_multinomial_matches_sklearn(runtime):
+    """The reference-parity multinomial event model must match sklearn's
+    MultinomialNB probabilities on count data and refuse signed input."""
+    from sklearn.naive_bayes import MultinomialNB
+
+    rng = np.random.default_rng(3)
+    n, d, C = 600, 12, 3
+    y = rng.integers(0, C, n)
+    rates = rng.uniform(0.5, 6.0, size=(C, d))
+    X = rng.poisson(rates[y]).astype(np.float32)
+
+    tr = get_trainer("nb")
+    model = tr(runtime, X, y, C, event_model="multinomial", smoothing=1.0)
+    probs = model.predict_proba(runtime, X)
+
+    sk = MultinomialNB(alpha=1.0).fit(X, y)
+    np.testing.assert_allclose(probs, sk.predict_proba(X),
+                               rtol=2e-4, atol=2e-5)
+
+    with pytest.raises(ValueError, match="non-negative"):
+        tr(runtime, X - 5.0, y, C, event_model="multinomial")
+
+    # Persistence restores the right predictor for the variant.
+    from learningorchestra_tpu.models import naive_bayes
+    from learningorchestra_tpu.models.registry import predictor_for
+    assert (predictor_for("nb", model.hparams)
+            is naive_bayes._predict_multinomial)
+    assert (predictor_for("nb", {"smoothing": 1e-3})
+            is naive_bayes._predict_proba)
+
+
 def test_lr_device_stats_avoid_cancellation(runtime):
     """Regression: standardization stats computed on-device must use the
     two-pass form — E[x²]−E[x]² in f32 collapses for |mean| ≫ std (e.g.
