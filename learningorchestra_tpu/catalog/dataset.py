@@ -165,10 +165,24 @@ class _Chunk:
 
     def materialize(self, fields: Optional[List[str]] = None) -> Columns:
         """Column data for this chunk (optionally a field subset). Disk
-        reads are NOT cached back — streaming consumers stay bounded."""
+        reads are NOT cached back — streaming consumers stay bounded.
+
+        Disk reads coerce to the chunk's *current* ``dtypes``: consolidation
+        may have re-pointed an already-flushed chunk at dtype-promoted (or
+        stringified) views before a budget eviction dropped them, leaving
+        the journaled file with the pre-promotion dtype. Re-applying the
+        ``_concat`` promotion rule here keeps streamed values identical to
+        what consolidation yields (no in-process drift)."""
         cols = self.cols
         if cols is None:
-            return read_chunk_parquet(self.path, fields)
+            data = read_chunk_parquet(self.path, fields)
+            for f, a in data.items():
+                want = self.dtypes.get(f)
+                if want is not None and a.dtype != want:
+                    data[f] = (stringify_numeric(a)
+                               if (want == object and a.dtype != object)
+                               else a.astype(want))
+            return data
         if fields is not None:
             return {f: cols[f] for f in fields}
         return cols
@@ -415,13 +429,34 @@ class Dataset:
         with self._data_lock:
             return self._rewrite_needed
 
-    @property
-    def generation(self) -> int:
-        """Current chunk-file generation — bumps on every rewrite,
-        including rewrites committed inline by budget eviction; the store's
-        mirror uses it to detect journal replacement."""
+    def journal_snapshot(self, gen: Optional[int] = None,
+                         offset: int = 0) -> tuple:
+        """Atomic journal snapshot for the store's mirror:
+        ``(generation, total_size, data, is_delta)``.
+
+        When ``gen`` matches the current generation, only bytes past
+        ``offset`` are read and ``is_delta`` is True — the O(delta) path a
+        per-chunk-checkpointing ingest needs (a full read per save would
+        be O(total journal), quadratic across the ingest). Otherwise the
+        whole journal is returned. Read under the data lock, so neither an
+        eviction flush (journal append) nor an inline generation rewrite
+        (journal *replacement*) can interleave: the returned bytes always
+        end on a record boundary and belong to exactly the returned
+        generation."""
         with self._data_lock:
-            return self._gen
+            cur = self._gen
+            data = b""
+            if self._journal_path is not None:
+                try:
+                    with open(self._journal_path, "rb") as f:
+                        if gen == cur and offset:
+                            f.seek(offset)
+                            data = f.read()
+                            return cur, offset + len(data), data, True
+                        data = f.read()
+                except FileNotFoundError:
+                    pass
+            return cur, len(data), data, False
 
     def journal_files(self) -> List[str]:
         """Basenames of the chunk files the current state references —

@@ -323,18 +323,33 @@ class DatasetStore:
                 if os.path.isfile(s) and not os.path.isfile(d):
                     shutil.copy2(s, d)
 
-        gen = ds.generation
+        # One atomic snapshot under the dataset's data lock: a concurrent
+        # eviction flush (journal append) or inline generation rewrite
+        # (journal *replacement*) cannot interleave, so the tracked offset
+        # always refers to this exact byte sequence — reading gen and size
+        # separately would let a rewrite land between them and the delta
+        # path would splice new-generation bytes after old-generation
+        # records in the replica. The snapshot reads only the delta when
+        # the generation matches (O(what was committed since last mirror)).
         state = self._mirror_state.get(name)
-        if os.path.isfile(src_journal):
-            size = os.path.getsize(src_journal)
-            full = (state is None or state[0] != gen or state[1] > size
-                    or not os.path.isfile(dst_journal))
-            if full:
-                copy_files(self._read_journal(src_journal))
+        known_gen, known_off = (state if state is not None
+                                and os.path.isfile(dst_journal)
+                                else (None, 0))
+        gen, size, data, is_delta = ds.journal_snapshot(known_gen, known_off)
+        if data or is_delta or os.path.isfile(src_journal):
+            records = _parse_journal_bytes(data)
+            copy_files(records)
+            if is_delta:
+                if data:
+                    with open(dst_journal, "ab") as d_f:
+                        d_f.write(data)
+            else:
                 tmp = dst_journal + ".tmp"
-                shutil.copy2(src_journal, tmp)
+                with open(tmp, "wb") as t_f:
+                    t_f.write(data)
                 os.replace(tmp, dst_journal)
-                referenced = set(ds.journal_files())
+                referenced = {rec["file"] for rec in records
+                              if rec.get("file")}
                 dst_chunks = os.path.join(dst, "chunks")
                 for fn in os.listdir(dst_chunks):
                     if fn not in referenced:
@@ -342,19 +357,6 @@ class DatasetStore:
                             os.remove(os.path.join(dst_chunks, fn))
                         except FileNotFoundError:
                             pass
-            elif size > state[1]:
-                with open(src_journal, "rb") as s_f:
-                    s_f.seek(state[1])
-                    delta = s_f.read(size - state[1])
-                records = []
-                for line in delta.decode("utf-8").splitlines():
-                    try:
-                        records.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        pass
-                copy_files(records)
-                with open(dst_journal, "ab") as d_f:
-                    d_f.write(delta)
             self._mirror_state[name] = (gen, size)
         meta = os.path.join(src, "metadata.json")
         if os.path.isfile(meta):
@@ -364,22 +366,12 @@ class DatasetStore:
 
     @staticmethod
     def _read_journal(path: str) -> List[Dict[str, Any]]:
-        """Parse journal records, tolerating a torn final line (a crash
-        mid-append commits nothing; the preceding prefix stays valid)."""
-        records = []
+        """Parse journal records from a file (load path)."""
         try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        records.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        break  # torn tail write — everything before is valid
+            with open(path, "rb") as f:
+                return _parse_journal_bytes(f.read())
         except FileNotFoundError:
-            pass
-        return records
+            return []
 
     def load(self, name: str) -> Dataset:
         """Load one persisted dataset into the catalog.
@@ -450,6 +442,21 @@ class DatasetStore:
             if not ds.metadata.finished and not ds.metadata.error:
                 self.fail(name, "interrupted: server restarted mid-job")
         return loaded
+
+
+def _parse_journal_bytes(data: bytes) -> List[Dict[str, Any]]:
+    """Journal bytes → records, tolerating a torn final line (a crash
+    mid-append commits nothing; the preceding prefix stays valid)."""
+    records: List[Dict[str, Any]] = []
+    for line in data.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            break  # torn tail write — everything before is valid
+    return records
 
 
 # -- query evaluation --------------------------------------------------------

@@ -92,7 +92,11 @@ def _fit_multinomial(X, y, n_valid, *, num_classes, alpha):
     with Laplace smoothing (pyspark NaiveBayes' default multinomial,
     reference model_builder.py:156) — one MXU contraction."""
     n, d = X.shape
-    onehot_T, _, prior, _ = _class_stats(y, n, n_valid, num_classes)
+    onehot_T, counts, _, _ = _class_stats(y, n, n_valid, num_classes)
+    # Spark smooths the class prior too: pi_c = log((n_c + lambda) /
+    # (n + numLabels*lambda)) — the unsmoothed prior stays gaussian-only.
+    prior = (jnp.log(counts + alpha)
+             - jnp.log(counts.sum() + alpha * num_classes))
     Ncd = onehot_T @ X                               # (C, d)
     theta = (jnp.log(Ncd + alpha)
              - jnp.log(Ncd.sum(axis=1, keepdims=True) + alpha * d))

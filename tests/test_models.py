@@ -80,7 +80,12 @@ def test_nb_multinomial_matches_sklearn(runtime):
     model = tr(runtime, X, y, C, event_model="multinomial", smoothing=1.0)
     probs = model.predict_proba(runtime, X)
 
-    sk = MultinomialNB(alpha=1.0).fit(X, y)
+    # Spark (the parity target) Laplace-smooths the class prior too:
+    # pi_c = (n_c + lambda) / (n + C*lambda). sklearn leaves the prior
+    # unsmoothed, so hand it the Spark prior to compare like for like.
+    counts = np.bincount(y, minlength=C).astype(np.float64)
+    spark_prior = (counts + 1.0) / (counts.sum() + C)
+    sk = MultinomialNB(alpha=1.0, class_prior=spark_prior).fit(X, y)
     np.testing.assert_allclose(probs, sk.predict_proba(X),
                                rtol=2e-4, atol=2e-5)
 

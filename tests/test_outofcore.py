@@ -13,7 +13,8 @@ import numpy as np
 import pytest
 
 from learningorchestra_tpu.catalog.ingest import ingest_csv_url
-from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.catalog.store import (
+    DatasetStore, column_value_counts)
 from learningorchestra_tpu.ops.histogram import create_histogram
 from learningorchestra_tpu.ops.projection import create_projection
 
@@ -301,6 +302,35 @@ def test_mixed_object_chunks_never_evict(budget_cfg):
         {"s": np.array([f"v{i}" for i in range(2000)], dtype=object)})
     assert ds2.mem_bytes == 0
     assert ds2.column("s")[1999] == "v1999"
+
+
+def test_evicted_promoted_chunk_streams_consolidated_dtypes(budget_cfg):
+    """Regression (ADVICE r3): consolidation re-points an already-flushed
+    numeric chunk at *stringified* views when a later chunk makes the column
+    object; evicting that chunk (path already set, so no re-flush) must not
+    let iter_chunks stream the file's raw numeric values next to string
+    chunks — the streaming histogram would split counts between 7.0 and
+    "7", drifting from value_counts on the same data."""
+    store = _budgeted_store(budget_cfg, 200 << 10)
+    ds = store.create("drift")
+    ds.append_columns({"a": np.arange(2000)})      # numeric chunk
+    store.save("drift")                            # journaled file is int64
+    ds.append_columns(
+        {"a": np.array([str(i) for i in range(2000)], dtype=object)})
+    assert ds.columns["a"].dtype == object         # consolidation promotes
+    # Push past the budget so the promoted chunk evicts.
+    ds.append_columns(
+        {"a": np.array([f"x{i}" for i in range(2000)], dtype=object)})
+    assert ds._chunks[0].cols is None              # scenario reached: evicted
+    chunks = list(ds.iter_chunks(["a"]))
+    first = chunks[0]["a"]
+    assert first.dtype == object
+    assert first[7] == "7"                         # not int64 7 from the file
+    streamed = np.concatenate([c["a"] for c in chunks])
+    assert ds.num_rows == len(streamed) == 6000
+    # Streamed values agree with consolidation (value_counts path).
+    assert column_value_counts(streamed) == column_value_counts(
+        ds.columns["a"])
 
 
 def test_gc_defers_while_streaming_reader_active(cfg, tmp_path):
