@@ -586,8 +586,8 @@ class Dataset:
     def column(self, name: str) -> np.ndarray:
         return self.columns[name]
 
-    def iter_chunks(self, fields: Optional[List[str]] = None
-                    ) -> Iterator[Columns]:
+    def iter_chunks(self, fields: Optional[List[str]] = None,
+                    max_chunks: Optional[int] = None) -> Iterator[Columns]:
         """Stream the dataset chunk-by-chunk without full materialization —
         the out-of-core compute path (histogram, projection). Spilled chunks
         are read from their parquet files one at a time and not cached.
@@ -605,9 +605,16 @@ class Dataset:
         generator function — the snapshot and reader registration happen at
         the first ``next()``, so an iterator that is never started never
         leaks a reader count.
+
+        ``max_chunks`` truncates the snapshot *before* dtype unification:
+        the SPMD histogram pins a journaled chunk count so every pod
+        process streams identical chunk boundaries AND identical unified
+        dtypes even if extra chunks appended on one process since.
         """
         with self._data_lock:
             chunks = list(self._chunks)
+            if max_chunks is not None:
+                chunks = chunks[:max_chunks]
             self._active_readers += 1
         try:
             want = fields

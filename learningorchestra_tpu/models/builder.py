@@ -167,32 +167,24 @@ class ModelBuilder:
             # fits sequentially — every process must execute the same
             # collective program in the same order (parallel/spmd.py), so
             # the thread-pool overlap (single-process FAIR behavior) does
-            # not apply. Datasets must be durable first: workers rebuild
-            # identical inputs from the shared store.
-            if not self.cfg.persist:
-                raise RuntimeError(
-                    "multi-process builds require a persisted shared "
-                    "store (LO_TPU_PERSIST=1 on a shared store_root)")
-            self.store.save(train)
-            self.store.save(test)
-            with device_trace(self.cfg), spmd.dispatch_guard():
-                # Row counts pin the snapshot: a concurrent ingest commit
-                # between this save and a worker's load must not change
-                # the collective program's shapes (workers truncate to
-                # these counts).
-                # State + feature fields pin the preprocessing snapshot
-                # too: a worker refitting stats over a longer dataset
-                # would otherwise build numerically different (or wider)
-                # matrices than process 0's.
-                spmd.dispatch({
-                    "op": "build", "train": train, "test": test,
-                    "label": label, "steps": list(steps),
-                    "classifiers": list(classifiers), "hparams": hparams,
-                    "n_train": int(len(X_train)),
-                    "n_test": int(len(X_test)),
-                    "state": spmd.jsonable_state(state),
-                    "feature_fields": list(feature_fields),
-                })
+            # not apply. Row counts pin the snapshot: a concurrent ingest
+            # commit between the save and a worker's load must not change
+            # the collective program's shapes (workers truncate to these
+            # counts). State + feature fields pin the preprocessing
+            # snapshot too: a worker refitting stats over a longer dataset
+            # would otherwise build numerically different (or wider)
+            # matrices than process 0's.
+            with device_trace(self.cfg), spmd.dispatch_job(
+                    self.store, (train, test), {
+                        "op": "build", "train": train, "test": test,
+                        "label": label, "steps": list(steps),
+                        "classifiers": list(classifiers),
+                        "hparams": hparams,
+                        "n_train": int(len(X_train)),
+                        "n_test": int(len(X_test)),
+                        "state": spmd.jsonable_state(state),
+                        "feature_fields": list(feature_fields),
+                    }):
                 return [fit_guarded(c) for c in classifiers]
 
         # Concurrent fits (reference: 5-way ThreadPoolExecutor + FAIR pool).
@@ -223,16 +215,15 @@ class ModelBuilder:
         if not existing:
             self.store.create(out_name, parent=dataset,
                               extra={"model": model_name, "kind": man["kind"]})
-        with timed("model_predict"), device_trace(self.cfg), \
-                spmd.dispatch_guard():
+        with timed("model_predict"), device_trace(self.cfg):
             X, _, _, _ = preprocess.design_matrix(
                 ds, pp["label"], pp["steps"], state=pp["state"],
                 feature_fields=pp["feature_fields"])
-            if spmd.is_multiprocess():
-                self.store.save(dataset)
-                spmd.dispatch({"op": "predict", "model": model_name,
-                               "dataset": dataset, "n_rows": int(len(X))})
-            probs = model.predict_proba(self.runtime, X)
+            with spmd.dispatch_job(
+                    self.store, (dataset,),
+                    {"op": "predict", "model": model_name,
+                     "dataset": dataset, "n_rows": int(len(X))}):
+                probs = model.predict_proba(self.runtime, X)
         preds = np.argmax(probs, axis=1)
         self._save_predictions(out_name, ds, preds, probs,
                                FitReport(kind=man["kind"], fit_time=0.0))

@@ -92,15 +92,13 @@ def _mesh_bincount(codes: jax.Array, n_valid: jax.Array, *,
 def field_counts(runtime: MeshRuntime, col: np.ndarray) -> Dict:
     """Value→count dict for one column, device path when it pays off.
 
-    Multi-process pods take the host path: the device bincount's psum is
-    not SPMD-dispatched to workers, and process 0 entering it alone would
-    wedge the pod (counting is cheap relative to a dispatch round-trip).
+    The device/host decision depends only on the column's dtype and value
+    range, so identical chunk data yields identical decisions on every
+    process of a pod — the property the SPMD histogram dispatch relies on.
     """
-    from learningorchestra_tpu.parallel import spmd
-
     if len(col) == 0:
         return {}
-    if col.dtype.kind in "iu" and not spmd.is_multiprocess():
+    if col.dtype.kind in "iu":
         lo, hi = int(col.min()), int(col.max())
         num_bins = hi - lo + 1
         if 0 < num_bins <= MAX_DEVICE_BINS:
@@ -120,6 +118,24 @@ def merge_counts(total: Dict, part: Dict) -> None:
         total[k] = total.get(k, 0) + v
 
 
+def histogram_totals(runtime: MeshRuntime, parent_ds, fields: List[str],
+                     max_chunks: Optional[int] = None) -> Dict[str, Dict]:
+    """Per-field value→count maps, streamed one chunk at a time.
+
+    This is the device-op sequence shared verbatim by process 0 and SPMD
+    workers (parallel/spmd.py ``prep_histogram_job``): per chunk, per
+    field, one ``field_counts`` call whose device/host decision depends
+    only on the chunk's data. With ``max_chunks`` pinned to a journaled
+    snapshot, every process iterates identical chunk boundaries in
+    identical order, so the collective programs line up.
+    """
+    totals: Dict[str, Dict] = {f: {} for f in fields}
+    for cols in parent_ds.iter_chunks(list(fields), max_chunks=max_chunks):
+        for f in fields:
+            merge_counts(totals[f], field_counts(runtime, cols[f]))
+    return totals
+
+
 def create_histogram(store: DatasetStore, runtime: MeshRuntime,
                      parent: str, name: str, fields: List[str],
                      existing: bool = False) -> None:
@@ -130,17 +146,32 @@ def create_histogram(store: DatasetStore, runtime: MeshRuntime,
     ever being fully materialized — matching the reference's disk-backed
     Mongo aggregation (histogram.py:49-74) at out-of-core scale.
 
+    Multi-process pods dispatch the job to every worker first (the full
+    scalable-tier behavior of the reference, where histogram-scale work
+    also ran against shared storage): the spec pins the parent's journaled
+    chunk count so all processes stream the same snapshot.
+
     ``existing=True`` means the API layer already created the output dataset
     (metadata-first protocol); otherwise it is created here.
     """
+    from learningorchestra_tpu.parallel import spmd
+
     parent_ds = store.get(parent)
     missing = [f for f in fields if f not in parent_ds.metadata.fields]
     if missing:
         raise ValueError(f"fields not in dataset: {missing}")
     ds = store.get(name) if existing else store.create(name, parent=parent)
-    totals: Dict[str, Dict] = {f: {} for f in fields}
-    for cols in parent_ds.iter_chunks(list(fields)):
-        for f in fields:
-            merge_counts(totals[f], field_counts(runtime, cols[f]))
+    pin: Dict[str, int] = {}
+
+    def make_spec():
+        # Evaluated after dispatch_job's save: the journaled chunk count
+        # is the snapshot every process streams.
+        pin["n_chunks"] = len(parent_ds.journal_files())
+        return {"op": "histogram", "parent": parent,
+                "fields": list(fields), "n_chunks": pin["n_chunks"]}
+
+    with spmd.dispatch_job(store, (parent,), make_spec):
+        totals = histogram_totals(runtime, parent_ds, fields,
+                                  max_chunks=pin.get("n_chunks"))
     ds.append_rows([{"field": f, "counts": totals[f]} for f in fields])
     store.finish(name)

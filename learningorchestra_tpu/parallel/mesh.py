@@ -167,6 +167,13 @@ class MeshRuntime:
             return self._mesh
 
     def shard_rows(self, arr: np.ndarray) -> Tuple[jax.Array, int]:
+        # Structural SPMD guard: on a multi-process pod, host→device entry
+        # is only legal inside a dispatched job scope (parallel/spmd.py) —
+        # every mesh op funnels through here or replicate, so nothing can
+        # "forget" to dispatch and wedge the pod mid-collective.
+        from learningorchestra_tpu.parallel import spmd
+
+        spmd.check_mesh_entry("shard_rows")
         if not isinstance(arr, np.ndarray):
             return shard_rows(self.mesh, arr)
         key = (id(arr), arr.shape, str(arr.dtype))
@@ -197,6 +204,9 @@ class MeshRuntime:
         return out
 
     def replicate(self, x) -> jax.Array:
+        from learningorchestra_tpu.parallel import spmd
+
+        spmd.check_mesh_entry("replicate")
         return replicate(self.mesh, x)
 
 

@@ -31,6 +31,7 @@ entry point no-ops when ``jax.process_count() == 1``.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -210,6 +211,43 @@ class _JobChannel:
 _channel: Optional[_JobChannel] = None
 _channel_lock = threading.Lock()
 _dispatch_lock = threading.Lock()
+#: Thread-local mesh-job scope: set while this thread is allowed to enter
+#: mesh collectives on a multi-process pod (process 0 inside dispatch_guard,
+#: workers while executing a dispatched job's device ops).
+_scope = threading.local()
+
+
+class mesh_scope:
+    """Marks the current thread as inside a dispatched SPMD job. The mesh
+    runtime's device-entry points (shard_rows/replicate) refuse to run on a
+    multi-process pod outside this scope — the *structural* guard that
+    makes "forgot to dispatch" a clean client error instead of a wedged
+    pod (every mesh op funnels host data through those entry points)."""
+
+    def __enter__(self):
+        _scope.depth = getattr(_scope, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _scope.depth -= 1
+        return False
+
+
+def in_mesh_scope() -> bool:
+    return getattr(_scope, "depth", 0) > 0
+
+
+def check_mesh_entry(what: str = "this mesh operation") -> None:
+    """Called by MeshRuntime.shard_rows/replicate: on a multi-process pod,
+    device entry requires an active dispatch scope, else the collectives
+    the op runs would execute on this process alone and deadlock the pod.
+    Raises the clean 406-style error instead."""
+    if is_multiprocess() and not in_mesh_scope():
+        raise ValueError(
+            f"{what} would enter mesh collectives on a multi-process pod "
+            "without an SPMD dispatch; run it through a dispatched job "
+            "(spmd.dispatch under dispatch_guard) or on a single-process "
+            "deployment")
 
 
 def _get_channel() -> _JobChannel:
@@ -242,21 +280,51 @@ def dispatch(spec: Dict[str, Any]) -> None:
     _get_channel().dispatch(spec)
 
 
+@contextlib.contextmanager
+def dispatch_job(store, inputs, make_spec):
+    """Process-0 preamble shared by every dispatched surface (build,
+    predict, embed, histogram): require a persisted shared store, commit
+    the input datasets workers rebuild from, serialize the mesh job, and
+    dispatch the spec — then run the caller's device ops inside the mesh
+    scope. ``make_spec`` may be the spec dict or a thunk evaluated *after*
+    the saves (specs that pin journaled state need the post-save view).
+    Single-process: plain passthrough (no guard, jobs stay overlapped)."""
+    if not is_multiprocess():
+        yield
+        return
+    if not store.cfg.persist:
+        op = (make_spec() if callable(make_spec) else make_spec).get("op")
+        raise RuntimeError(
+            f"multi-process {op} jobs require a persisted shared store "
+            "(LO_TPU_PERSIST=1 on a shared store_root)")
+    for name in inputs:
+        store.save(name)
+    with dispatch_guard():
+        dispatch(make_spec() if callable(make_spec) else make_spec)
+        yield
+
+
 class dispatch_guard:
-    """Serializes mesh jobs under multi-process operation.
+    """Serializes mesh jobs under multi-process operation and opens the
+    mesh scope for the calling thread.
 
     Collective programs from concurrently dispatched jobs would interleave
     differently on each process and deadlock; the guard makes dispatch +
     compute atomic. Single-process mode is a no-op (concurrent fits stay
     overlapped, the FAIR-scheduler behavior)."""
 
+    def __init__(self):
+        self._scope = mesh_scope()
+
     def __enter__(self):
         if is_multiprocess():
             _dispatch_lock.acquire()
+            self._scope.__enter__()
         return self
 
     def __exit__(self, *exc):
         if is_multiprocess():
+            self._scope.__exit__(*exc)
             _dispatch_lock.release()
         return False
 
@@ -282,6 +350,17 @@ def jsonable_state(state: Dict[str, Any]) -> Dict[str, Any]:
         return v
 
     return conv(state)
+
+
+def _require_snapshot(seen: int, pinned: Optional[int], what: str) -> None:
+    """Shared staleness guard for preppers: the dispatched spec pins a
+    snapshot (row/chunk counts); a worker seeing *less* than the pin means
+    the shared store is out of sync and prep must nack (fail before 'go')
+    rather than let collectives diverge."""
+    if pinned is not None and seen < pinned:
+        raise RuntimeError(
+            f"worker sees fewer {what} ({seen}) than the dispatched "
+            f"snapshot ({pinned}) — shared store out of sync")
 
 
 def prep_build_job(store, runtime, spec: Dict[str, Any]):
@@ -314,11 +393,8 @@ def prep_build_job(store, runtime, spec: Dict[str, Any]):
         test_ds, label, steps, state=state, feature_fields=ff)
     n_train, n_test = spec.get("n_train"), spec.get("n_test")
     if n_train is not None:
-        if len(X_train) < n_train or len(X_test) < n_test:
-            raise RuntimeError(
-                f"worker sees fewer rows than the dispatched snapshot "
-                f"({len(X_train)}/{n_train} train, {len(X_test)}/{n_test} "
-                "test) — shared store out of sync")
+        _require_snapshot(len(X_train), n_train, "train rows")
+        _require_snapshot(len(X_test), n_test, "test rows")
         X_train, y_train = X_train[:n_train], y_train[:n_train]
         X_test = X_test[:n_test]
         y_test = y_test[:n_test] if y_test is not None else None
@@ -353,15 +429,63 @@ def prep_predict_job(store, runtime, spec: Dict[str, Any]):
         feature_fields=pp["feature_fields"])
     n = spec.get("n_rows")
     if n is not None:
-        if len(X) < n:
-            raise RuntimeError(
-                f"worker sees fewer rows ({len(X)}) than the dispatched "
-                f"snapshot ({n}) — shared store out of sync")
+        _require_snapshot(len(X), n, "rows")
         X = X[:n]
     return lambda: model.predict_proba(runtime, X)
 
 
-_PREPPERS = {"build": prep_build_job, "predict": prep_predict_job}
+def prep_embed_job(store, runtime, spec: Dict[str, Any]):
+    """Host-side prep mirroring ``create_embedding_image``'s compute: build
+    the identical design matrix from the shared store (pinned rows +
+    preprocessing state) and return the device-op callable running the
+    same tsne/pca embed. PNG rendering is process-0 business."""
+    from learningorchestra_tpu.ops import preprocess
+    from learningorchestra_tpu.viz.pca import pca_embed
+    from learningorchestra_tpu.viz.tsne import tsne_embed
+
+    ds = store.load(spec["parent"])
+    X, _, _, _ = preprocess.design_matrix(
+        ds, spec["label"] or "__none__", (), state=spec.get("state"),
+        feature_fields=spec.get("feature_fields"))
+    n = spec["n_rows"]
+    _require_snapshot(len(X), n, "rows")
+    X = X[:n]
+    kwargs = spec.get("embed_kwargs") or {}
+    method = spec["method"]
+    if method == "pca":
+        return lambda: pca_embed(runtime, X)
+    if method == "tsne":
+        return lambda: tsne_embed(runtime, X, **kwargs)
+    raise ValueError(f"unknown embed method: {method!r}")
+
+
+def prep_histogram_job(store, runtime, spec: Dict[str, Any]):
+    """Host-side prep mirroring ``create_histogram``'s streamed device
+    counts. The spec pins the parent's journaled chunk count: chunk files
+    are immutable and journal order is append-only, so truncating both
+    sides to the pinned count makes every per-chunk device bincount (and
+    the host/device path decision, which depends only on chunk data)
+    identical across processes. Result writing is process-0 business.
+
+    Unlike build/predict/embed (pure compute after 'go'), the streamed
+    device ops re-read chunk files between collectives, so prep walks
+    every chunk once first: a missing/corrupt chunk file nacks here —
+    before 'go' — instead of wedging the pod mid-psum. The re-read at
+    device time is cheap (immutable files, warm page cache)."""
+    from learningorchestra_tpu.ops.histogram import histogram_totals
+
+    ds = store.load(spec["parent"])
+    n_chunks = spec["n_chunks"]
+    fields = spec["fields"]
+    _require_snapshot(len(ds.journal_files()), n_chunks, "chunks")
+    for _ in ds.iter_chunks(list(fields), max_chunks=n_chunks):
+        pass  # validation pass: every read that 'go' will need, fallible now
+    return lambda: histogram_totals(runtime, ds, fields,
+                                    max_chunks=n_chunks)
+
+
+_PREPPERS = {"build": prep_build_job, "predict": prep_predict_job,
+             "embed": prep_embed_job, "histogram": prep_histogram_job}
 
 
 def _connect_to_controller(timeout_s: float = 120.0) -> socket.socket:
@@ -440,21 +564,12 @@ def worker_loop(store, runtime) -> None:
         verdict = json.loads(line).get("op")
         if verdict == "go" and device_ops is not None:
             try:
-                device_ops()
+                with mesh_scope():
+                    device_ops()
             except Exception:  # noqa: BLE001 — keep the loop alive
                 log.exception("worker device ops for %r failed", op)
         elif verdict == "shutdown":
             return
-
-
-def require_single_process(what: str) -> None:
-    """Guard for mesh ops that are not yet SPMD-dispatched to workers:
-    running their collectives on process 0 alone would wedge the pod.
-    Raises a clean client error (406) instead."""
-    if is_multiprocess():
-        raise ValueError(
-            f"{what} is not SPMD-dispatched yet and cannot run on a "
-            "multi-process pod; run it on a single-process deployment")
 
 
 def shutdown_workers() -> None:

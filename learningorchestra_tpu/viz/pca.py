@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.parallel.mesh import MeshRuntime, host_rows
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -38,10 +38,20 @@ def _pca_project(X, n_valid, *, k):
 
 def pca_embed(runtime: MeshRuntime, X: np.ndarray,
               k: int = 2) -> np.ndarray:
-    """(n, d) host matrix → (n, k) principal-component embedding."""
-    from learningorchestra_tpu.parallel import spmd
+    """(n, d) host matrix → (n, k) principal-component embedding.
 
-    spmd.require_single_process("pca")
-    X_dev, n = runtime.shard_rows(np.asarray(X, np.float32))
+    Runs on multi-process pods too (every process calls this through the
+    SPMD dispatch protocol): the embedding is row-sharded, so the
+    host-side gather is ``host_rows`` (process_allgather when shards span
+    processes), not a plain copy."""
+    X = np.asarray(X, np.float32)
+    if X.ndim != 2 or X.shape[1] < k:
+        # Matches sklearn's n_components <= n_features contract (the
+        # reference's PCA(2) likewise rejects 1-column data) but as a
+        # clean client error instead of an IndexError mid-plot.
+        raise ValueError(
+            f"pca with {k} components needs at least {k} numeric feature "
+            f"columns; dataset has {X.shape[1] if X.ndim == 2 else 0}")
+    X_dev, n = runtime.shard_rows(X)
     emb, _ = _pca_project(X_dev, runtime.replicate(np.int32(n)), k=k)
-    return np.asarray(emb)[:n]
+    return host_rows(emb)[:n]

@@ -41,18 +41,37 @@ def create_embedding_image(store: DatasetStore, runtime: MeshRuntime,
     reference's POST also returns before the PNG exists and clients GET
     until 200). Label-encoding of string columns before embedding matches
     the reference's LabelEncoder pass (tsne.py:82-86).
+
+    Multi-process pods dispatch the embed to every worker first — the
+    reference ran tsne/pca's data load through the shared Spark tier
+    (reference tsne.py:74-80), so a pod deployment must serve them too.
+    The spec pins the row count and fitted preprocessing state so workers
+    rebuild bit-identical design matrices from the shared store; PNG
+    rendering stays process-0 business.
     """
+    from learningorchestra_tpu.parallel import spmd
+
     cfg_root = image_root or global_settings.image_root
     parent_ds = store.get(parent)
     if label is not None and label not in parent_ds.metadata.fields:
         raise ValueError(f"label field not in dataset: {label}")
-    X, y, _, _ = design_matrix(parent_ds, label or "__none__")
-    if method == "pca":
-        emb = pca_embed(runtime, X)
-    elif method == "tsne":
-        emb = tsne_embed(runtime, X, **embed_kwargs)
-    else:
+    if method not in ("pca", "tsne"):
         raise ValueError(f"unknown embedding method: {method}")
+    X, y, feature_fields, state = design_matrix(parent_ds,
+                                                label or "__none__")
+
+    def embed():
+        if method == "pca":
+            return pca_embed(runtime, X)
+        return tsne_embed(runtime, X, **embed_kwargs)
+
+    with spmd.dispatch_job(store, (parent,), {
+            "op": "embed", "method": method, "parent": parent,
+            "label": label, "n_rows": int(len(X)),
+            "state": spmd.jsonable_state(state),
+            "feature_fields": list(feature_fields),
+            "embed_kwargs": embed_kwargs}):
+        emb = embed()
     labels = None
     if label is not None:
         labels = parent_ds.columns[label]

@@ -213,10 +213,16 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
                exaggeration_iters: int = 250, eta: Optional[float] = None,
                seed: int = 0, pca_dims: int = 50,
                tile: int = _TILE) -> np.ndarray:
-    """(n, d) host matrix → (n, 2) t-SNE embedding."""
-    from learningorchestra_tpu.parallel import spmd
+    """(n, d) host matrix → (n, 2) t-SNE embedding.
 
-    spmd.require_single_process("tsne")
+    Runs on multi-process pods too (every process calls this through the
+    SPMD dispatch protocol). The kNN/calibration front end is computed
+    per-process on local devices (deterministic — same input, same
+    program) and handed to the descent loop as *host* arrays: jit treats
+    numpy inputs as identical on every process and replicates them
+    globally, so the sharded-repulsion ``shard_map`` over the global mesh
+    sees consistent global arrays, and the iteration state it returns
+    stays replicated across the loop."""
     X = np.asarray(X, np.float32)
     n, d = X.shape
     if d > pca_dims:
@@ -232,29 +238,48 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
     Xp, n_valid = _pad_rows(X, pad_to)
     k = min(int(3 * perplexity), n - 1)
 
-    d2k, idx = _knn(jnp.asarray(Xp), k=k, tile=tile)
-    P = _calibrate(d2k[:n_valid], jnp.float32(perplexity))
-    P = jnp.concatenate(
-        [P, jnp.zeros((len(Xp) - n_valid, k), jnp.float32)], axis=0)
-
-    rng = np.random.default_rng(seed)
-    Y = jnp.asarray(rng.normal(scale=1e-4, size=(len(Xp), 2)),
-                    dtype=jnp.float32)
-    vel = jnp.zeros_like(Y)
-    gains = jnp.ones_like(Y)
-    if eta is None:
-        eta = max(float(n_valid) / 12.0 / 4.0, 50.0)  # learning rate n/48
-    nv = jnp.float32(n_valid)
     # The fused kernel wants lane-width (≥128) tiles; tiny datasets use the
     # XLA scan path, which is compile-time-cheaper there anyway.
     use_pallas = bool(runtime.cfg.use_pallas) and tile >= 128
     step_mesh = mesh if shard else None
+    # Sharded descent needs *global replicated* device inputs (a pod's
+    # shard_map spans processes; per-process local arrays would not line
+    # up). Unsharded small problems stay on one local device. Either way
+    # everything is placed on device ONCE before the loop — per-iteration
+    # host transfers would dominate at this problem size.
+    put = runtime.replicate if step_mesh is not None else jnp.asarray
 
+    d2k, idx_dev = _knn(jnp.asarray(Xp), k=k, tile=tile)
+    P_cal = _calibrate(d2k[:n_valid], jnp.float32(perplexity))
+    # kNN/calibration run per-process on local devices (deterministic);
+    # round-trip through host so `put` can place them replicated globally.
+    idx = put(np.asarray(idx_dev))
+    P = put(np.concatenate(
+        [np.asarray(P_cal),
+         np.zeros((len(Xp) - n_valid, k), np.float32)], axis=0))
+
+    rng = np.random.default_rng(seed)
+    Y = put(rng.normal(scale=1e-4, size=(len(Xp), 2)).astype(np.float32))
+    vel = put(np.zeros((len(Xp), 2), np.float32))
+    gains = put(np.ones((len(Xp), 2), np.float32))
+    if eta is None:
+        eta = max(float(n_valid) / 12.0 / 4.0, 50.0)  # learning rate n/48
+    nv = put(np.float32(n_valid))
+    eta_d = put(np.float32(eta))
+    exag_d = {True: put(np.float32(12.0)), False: put(np.float32(1.0))}
+    mom_d = {True: put(np.float32(0.5)), False: put(np.float32(0.8))}
+
+    # XLA's CPU backend can deadlock when collective programs pipeline
+    # deeply (in-flight runs share one thunk pool; a later run's
+    # rendezvous threads can starve an earlier run's stragglers on
+    # oversubscribed hosts). The CPU mesh is the multi-chip simulation
+    # rig, so serialize steps there; TPU keeps the async dispatch queue.
+    sync_steps = step_mesh is not None and jax.default_backend() == "cpu"
     for it in range(iters):
-        exag = 12.0 if it < exaggeration_iters else 1.0
-        momentum = 0.5 if it < exaggeration_iters else 0.8
+        early = it < exaggeration_iters
         Y, vel, gains = _step(Y, vel, gains, P, idx, nv,
-                              jnp.float32(exag), jnp.float32(eta),
-                              jnp.float32(momentum), tile=tile,
+                              exag_d[early], eta_d, mom_d[early], tile=tile,
                               use_pallas=use_pallas, mesh=step_mesh)
+        if sync_steps:
+            jax.block_until_ready(Y)
     return np.asarray(Y)[:n_valid]

@@ -55,16 +55,45 @@ def make_split(seed, n):
 
 if pid == 0:
     from learningorchestra_tpu.models.builder import ModelBuilder
+    from learningorchestra_tpu.ops.histogram import create_histogram
+    from learningorchestra_tpu.viz.pca import pca_embed
+    from learningorchestra_tpu.viz.service import create_embedding_image
 
     store.create("sp_train", columns=make_split(0, 4000), finished=True)
     store.create("sp_test", columns=make_split(1, 1000), finished=True)
+    store.create("sp_histsrc",
+                 columns={"v": (np.arange(6000) % 11).astype(np.int64)},
+                 finished=True)
     mb = ModelBuilder(store, runtime, cfg)
     try:
         reports = mb.build("sp_train", "sp_test", "sp_pred", ["lr", "nb"],
                            "label")
+        out = {r.kind: dict(r.metrics, fit_time=r.fit_time) for r in reports}
+
+        # The full API surface runs on the pod, not just build/predict
+        # (reference: every service's compute went through the shared
+        # Spark tier, tsne.py:74-80 / projection.py:104-111).
+        out["pca_png"] = create_embedding_image(
+            store, runtime, "pca", "sp_train", "sp_pca", label="label",
+            image_root=os.path.join(root, "img"))
+        out["tsne_png"] = create_embedding_image(
+            store, runtime, "tsne", "sp_train", "sp_tsne", label="label",
+            image_root=os.path.join(root, "img"),
+            perplexity=10, iters=30, exaggeration_iters=10, tile=128)
+
+        create_histogram(store, runtime, "sp_histsrc", "sp_hist", ["v"])
+        hrow = store.read("sp_hist", skip=1, limit=1)[0]
+        out["hist_counts"] = hrow["counts"]
+
+        # Structural guard: an op nobody dispatched must refuse cleanly
+        # (clean client error), never enter a lone collective and wedge.
+        try:
+            pca_embed(runtime, np.zeros((64, 4), np.float32))
+            out["guard"] = "MISSING"
+        except ValueError as exc:
+            out["guard"] = f"refused: {exc}"
     finally:
         spmd.shutdown_workers()
-    out = {r.kind: dict(r.metrics, fit_time=r.fit_time) for r in reports}
     # The prediction datasets must exist with finished metadata + rows.
     for kind in ("lr", "nb"):
         doc = store.read(f"sp_pred_{kind}", limit=1)[0]

@@ -3,9 +3,12 @@ run on CPU — the TPU-native analogue of the reference's multi-machine Spark
 scale-out (reference docker-compose.yml:123-163, docs/usage.md:21-33).
 
 Two OS processes × 4 virtual CPU devices join one 8-device mesh; process 0
-owns the catalog and dispatches a model build, process 1 runs the SPMD
-worker loop, and every collective genuinely crosses the process boundary
-(make_array_from_callback sharding + psum + process_allgather)."""
+owns the catalog and dispatches the FULL API surface — a model build, a
+t-SNE image, a PCA image, and a device histogram — while process 1 runs the
+SPMD worker loop; every collective genuinely crosses the process boundary
+(make_array_from_callback sharding + psum + all_gather +
+process_allgather). Also pins the structural guard: an undispatched mesh op
+on the pod refuses cleanly instead of wedging a collective."""
 
 import json
 import os
@@ -39,7 +42,7 @@ def test_two_process_model_build(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=420)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -57,3 +60,11 @@ def test_two_process_model_build(tmp_path):
     assert result["nb"]["f1"] > 0.85, result
     assert result["lr"]["pred_rows"] == 1000
     assert "error" not in result["lr"] and "error" not in result["nb"]
+    # The rest of the API surface ran on the pod too.
+    assert os.path.isfile(result["pca_png"]), result
+    assert os.path.isfile(result["tsne_png"]), result
+    # Device histogram (mesh bincount + cross-process psum) is exact.
+    assert result["hist_counts"] == {
+        str(v): (546 if v < 5 else 545) for v in range(11)}, result
+    # Undispatched mesh ops refuse cleanly on a pod.
+    assert result["guard"].startswith("refused"), result
