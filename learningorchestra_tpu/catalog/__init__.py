@@ -1,2 +1,13 @@
-from learningorchestra_tpu.catalog.dataset import Dataset, Metadata  # noqa: F401
-from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: F401
+# Import pyarrow eagerly, on the thread that first imports the catalog
+# (the process main thread in every real entrypoint). Deferring it can be
+# fatal: if pyarrow's first import happens on a worker thread of a
+# jax-loaded process (e.g. an ingest parse-pool thread hitting a lazy
+# `import pyarrow` in catalog.native), its static initialization corrupts
+# the process and a later `pq.read_table` segfaults — reproduced
+# deterministically (4/4 with worker-thread import, 0/4 with main-thread
+# import) on this image's jax+pyarrow pairing.
+import pyarrow  # noqa: F401
+import pyarrow.parquet  # noqa: F401
+
+from learningorchestra_tpu.catalog.dataset import Dataset, Metadata  # noqa: F401,E402
+from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: F401,E402

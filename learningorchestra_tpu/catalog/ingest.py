@@ -3,15 +3,28 @@
 Reproduces the reference's 3-stage producer-consumer ingest pipeline —
 downloader thread → row-transformer thread → DB-writer thread linked by two
 bounded Queue(1000)s, inserting one Mongo document per row
-(reference database.py:133-216) — re-designed columnar:
+(reference database.py:133-216) — re-designed columnar and parallel:
 
-- stage 1 (thread): HTTP-stream the CSV body into a bounded byte-chunk queue
-  (backpressure == the reference's bounded queues);
-- stage 2 (caller thread): a file-like adapter over that queue feeds a chunked
-  CSV parser (native C++ parser when built, pandas otherwise) producing
-  64k-row *column chunks* appended to the dataset — thousands of times fewer
-  append operations than the reference's per-row ``insert_one``
-  (database.py:176), which SURVEY.md §3.1 identifies as its ingest ceiling.
+- stage 1 (thread): HTTP-stream the CSV body into a bounded byte-chunk
+  queue (backpressure == the reference's bounded queues);
+- stage 2 (caller thread): split the byte stream into *row-aligned blocks*
+  (quote-parity-aware, at native speed), tracking the absolute source byte
+  offset of every block boundary;
+- stage 3 (thread pool): parse blocks concurrently — the native C++
+  tokenizer emits whole-column Arrow buffers and releases the GIL for the
+  full call, so parsing scales with ``ingest_parse_threads``; pandas is
+  the fallback parser per block;
+- stage 4 (caller thread): append parsed chunks *in source order* and
+  commit in batches (`ingest_commit_bytes`): one journal fsync per batch
+  instead of per chunk — thousands of times fewer durability round-trips
+  than the reference's per-row ``insert_one`` (database.py:176), which
+  SURVEY.md §3.1 identifies as its ingest ceiling.
+
+Every journal record carries the block's end byte offset in the source
+(``src_off``), so an ingest killed mid-flight resumes from the last
+committed byte (``resume_ingest``) instead of restarting — an upgrade over
+the reference, whose mid-flight crash leaves ``finished: false`` forever
+(SURVEY.md §5).
 
 URL validation matches the reference's sniff-first-line check rejecting
 HTML/JSON payloads (database.py:183-197). Type handling matches the
@@ -21,10 +34,13 @@ strings become numbers, empty strings become null.
 
 from __future__ import annotations
 
+import csv
 import io
+import os
 import queue
 import threading
-from typing import Iterator, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, List, Optional
 
 import numpy as np
 
@@ -40,34 +56,6 @@ _CHUNK_BYTES = 1 << 20          # 1 MiB download chunks
 _QUEUE_DEPTH = 64               # bounded: ~64 MiB in flight max
 
 
-class _QueueReader(io.RawIOBase):
-    """File-like view over a bounded queue of byte chunks (the pipeline
-    coupling; None sentinel = EOF, an Exception instance = producer error)."""
-
-    def __init__(self, q: "queue.Queue"):
-        self._q = q
-        self._buf = b""
-        self._eof = False
-
-    def readable(self) -> bool:
-        return True
-
-    def readinto(self, b) -> int:
-        while not self._buf and not self._eof:
-            item = self._q.get()
-            if item is None:
-                self._eof = True
-            elif isinstance(item, Exception):
-                self._eof = True
-                raise item
-            else:
-                self._buf = item
-        n = min(len(b), len(self._buf))
-        b[:n] = self._buf[:n]
-        self._buf = self._buf[n:]
-        return n
-
-
 def _sniff_header(first_chunk: bytes, url: str) -> None:
     """Reject obviously-non-CSV payloads, as the reference does by checking
     the first line for HTML/JSON markers (database.py:183-197)."""
@@ -76,19 +64,86 @@ def _sniff_header(first_chunk: bytes, url: str) -> None:
         raise InvalidCsvUrl(f"url does not look like CSV: {url}")
 
 
-def _open_url_stream(url: str, timeout: float):
+def _skip_bytes(chunks: Iterator[bytes], n: int) -> Iterator[bytes]:
+    """Drop the first ``n`` bytes of a chunk iterator (resume fallback for
+    servers that ignore Range requests)."""
+    for chunk in chunks:
+        if n >= len(chunk):
+            n -= len(chunk)
+            continue
+        if n:
+            chunk = chunk[n:]
+            n = 0
+        yield chunk
+
+
+def _source_identity(url: str, timeout: float) -> dict:
+    """Best-effort identity of the source content: validators a resume can
+    check to detect a source that changed since the interrupted ingest
+    began (resuming a byte offset into *different* content would silently
+    splice mismatched rows). File sources use (length, mtime); HTTP uses
+    ETag / Last-Modified / Content-Length from a HEAD request. Empty dict
+    when nothing is observable."""
+    try:
+        if url.startswith(("http://", "https://")):
+            import requests
+
+            resp = requests.head(url, timeout=timeout,
+                                 allow_redirects=True)
+            if resp.status_code >= 400:
+                return {}
+            out = {}
+            if resp.headers.get("ETag"):
+                out["etag"] = resp.headers["ETag"]
+            if resp.headers.get("Last-Modified"):
+                out["last_modified"] = resp.headers["Last-Modified"]
+            if resp.headers.get("Content-Length"):
+                out["length"] = int(resp.headers["Content-Length"])
+            return out
+        path = url[len("file://"):] if url.startswith("file://") else url
+        st = os.stat(path)
+        return {"length": st.st_size, "mtime": st.st_mtime}
+    except Exception:  # noqa: BLE001 — identity is advisory
+        return {}
+
+
+class SourceChanged(ValueError):
+    """The ingest source no longer matches what the committed prefix was
+    parsed from; resuming would corrupt the dataset."""
+
+
+def _open_url_stream(url: str, timeout: float,
+                     offset: int = 0) -> Iterator[bytes]:
     """Yield byte chunks from a URL (http(s)://) or local file (file:// or
-    bare path — used by tests and the bench harness)."""
+    bare path — used by tests and the bench harness), optionally starting
+    at a byte offset (ingest resume). HTTP uses a Range request, falling
+    back to skip-reading when the server ignores it."""
     if url.startswith(("http://", "https://")):
         import requests
 
-        resp = requests.get(url, stream=True, timeout=timeout)
+        headers = {"Range": f"bytes={offset}-"} if offset else {}
+        resp = requests.get(url, stream=True, timeout=timeout,
+                            headers=headers)
+        if offset and resp.status_code == 416:
+            # The source is now SHORTER than the committed offset (the
+            # offset==length case is handled before streaming starts):
+            # the content changed — refuse rather than mark a truncated
+            # dataset finished.
+            raise SourceChanged(
+                f"source at {url} is shorter than the committed resume "
+                f"offset {offset}; it must have changed since the "
+                "interrupted ingest")
         resp.raise_for_status()
-        return resp.iter_content(chunk_size=_CHUNK_BYTES)
+        it = resp.iter_content(chunk_size=_CHUNK_BYTES)
+        if offset and resp.status_code != 206:
+            it = _skip_bytes(it, offset)
+        return it
     path = url[len("file://"):] if url.startswith("file://") else url
 
     def file_chunks() -> Iterator[bytes]:
         with open(path, "rb") as f:
+            if offset:
+                f.seek(offset)
             while True:
                 chunk = f.read(_CHUNK_BYTES)
                 if not chunk:
@@ -98,16 +153,111 @@ def _open_url_stream(url: str, timeout: float):
     return file_chunks()
 
 
+def _record_split(buf: bytearray, n: int, cfg) -> int:
+    """Index of the last newline terminating a complete record (even quote
+    parity) within ``buf[:n]`` — native (zero-copy over the accumulation
+    buffer) when built, C-speed Python primitives otherwise."""
+    from learningorchestra_tpu.catalog import native
+
+    if cfg.use_native_csv and native.available():
+        return native.record_split_buffer(buf, n)
+    return native._record_split_py(bytes(buf[:n]))
+
+
+def _parse_block(block: bytes, fields: List[str], cfg):
+    """Parse one headerless row-aligned block → pyarrow.RecordBatch
+    (native) or Columns dict (pandas fallback). Runs on pool threads —
+    must not touch the dataset."""
+    if cfg.use_native_csv:
+        from learningorchestra_tpu.catalog import native
+
+        if native.available():
+            return native.parse_csv_block_arrow(block, names=fields)
+    import pandas as pd
+
+    text = io.TextIOWrapper(io.BytesIO(block), encoding="utf-8",
+                            errors="replace")
+    try:
+        frame = pd.read_csv(text, names=fields, header=None)
+    except pd.errors.EmptyDataError:   # all-blank block
+        return {}
+    return frame_to_columns(frame)
+
+
+def _append_parsed(ds, parsed, src_off: int) -> int:
+    """Append a parsed block (either representation) with its source
+    offset; returns its approximate in-memory size."""
+    if isinstance(parsed, dict):
+        ds.append_columns(parsed, src_off=src_off)
+        from learningorchestra_tpu.catalog.dataset import _arr_bytes
+
+        return sum(_arr_bytes(a) for a in parsed.values())
+    ds.append_arrow(parsed, src_off=src_off)
+    return int(parsed.nbytes)
+
+
 def ingest_csv_url(store: DatasetStore, name: str, url: str,
                    cfg=None) -> None:
     """Synchronous core of ingestion; run under JobManager for async.
 
     The dataset must already exist with ``finished=False`` (created by the
-    API layer before returning 201, mirroring the reference's metadata-first
-    insert at database.py:205-213).
+    API layer before returning 201, mirroring the reference's
+    metadata-first insert at database.py:205-213).
     """
+    _run_ingest(store, name, url, cfg or global_settings, start_offset=None)
+
+
+def resume_ingest(store: DatasetStore, name: str, cfg=None) -> None:
+    """Continue an ingest interrupted by process death from the last
+    journal-committed source byte (VERDICT r3 §4). Safe because chunk
+    commits are atomic-prefix: every committed chunk carries the offset
+    just past its last row, so re-opening the source there reproduces the
+    exact remaining rows — provided the source itself is unchanged, which
+    is validated against the identity (ETag/Last-Modified/length, or file
+    length+mtime) captured when the ingest began."""
     cfg = cfg or global_settings
     ds = store.get(name)
+    url = ds.metadata.url
+    if not url:
+        raise ValueError(f"dataset {name} has no source url to resume from")
+    offset = ds.resume_offset
+    if ds.num_rows and offset is None:
+        raise ValueError(
+            f"dataset {name} has committed chunks without source offsets; "
+            "resume would duplicate rows")
+    if offset:
+        recorded = ds.metadata.extra.get("source_id") or {}
+        current = _source_identity(url, cfg.download_timeout)
+        for key in ("etag", "last_modified", "mtime", "length"):
+            if key in recorded and key in current \
+                    and recorded[key] != current[key]:
+                raise SourceChanged(
+                    f"source {key} changed since the interrupted ingest "
+                    f"({recorded[key]!r} -> {current[key]!r}); resuming at "
+                    f"byte {offset} would splice mismatched content")
+        if current.get("length") == offset:
+            # Every byte was already committed; the crash just lost the
+            # finish flip.
+            store.finish(name)
+            return
+    _run_ingest(store, name, url, cfg, start_offset=offset)
+
+
+def _run_ingest(store: DatasetStore, name: str, url: str, cfg,
+                start_offset: Optional[int]) -> None:
+    ds = store.get(name)
+    resuming = start_offset is not None and start_offset > 0
+    fields = list(ds.metadata.fields) if resuming else None
+    if resuming and not fields:
+        raise ValueError(
+            f"dataset {name} has a resume offset but no recorded fields")
+    if not resuming:
+        # Capture the source's identity so a future resume can detect a
+        # changed source (resume_ingest checks it before trusting the
+        # committed byte offset). Persisted with the first chunk commit.
+        identity = _source_identity(url, cfg.download_timeout)
+        if identity:
+            ds.metadata.extra["source_id"] = identity
 
     chunks_q: "queue.Queue" = queue.Queue(maxsize=_QUEUE_DEPTH)
     cancel = threading.Event()
@@ -124,8 +274,9 @@ def ingest_csv_url(store: DatasetStore, name: str, url: str,
 
     def downloader() -> None:
         try:
-            first = True
-            for chunk in _open_url_stream(url, cfg.download_timeout):
+            first = not resuming
+            for chunk in _open_url_stream(url, cfg.download_timeout,
+                                          offset=start_offset or 0):
                 if first:
                     _sniff_header(chunk, url)
                     first = False
@@ -138,16 +289,16 @@ def ingest_csv_url(store: DatasetStore, name: str, url: str,
     t = threading.Thread(target=downloader, daemon=True, name="lo-ingest-dl")
     t.start()
 
-    reader = io.BufferedReader(_QueueReader(chunks_q), buffer_size=_CHUNK_BYTES)
+    # Default to 4 threads even on 1-core boxes: parse calls release the
+    # GIL and overlap the committer's write/fsync syscall waits, which is
+    # worth ~20% wall-clock there (measured); more cores, more threads.
+    n_threads = cfg.ingest_parse_threads or min(8, max(4,
+                                                       os.cpu_count() or 1))
+    pool = ThreadPoolExecutor(max_workers=n_threads,
+                              thread_name_prefix="lo-ingest-parse")
     try:
-        for cols in parse_csv_chunks(reader, cfg.ingest_chunk_rows, cfg):
-            ds.append_columns(cols)
-            if cfg.persist:
-                # Incremental commit: O(chunk) journaled flush per parsed
-                # chunk — the durability granularity the reference got from
-                # per-row Mongo inserts (database.py:171-181), thousands of
-                # rows at a time instead of one.
-                store.save(name)
+        _pipeline(store, ds, name, chunks_q, pool, n_threads, fields,
+                  start_offset or 0, cfg)
     finally:
         # Unblock and reap the downloader even when the parser raised
         # mid-stream; otherwise it parks forever on the bounded queue
@@ -159,7 +310,105 @@ def ingest_csv_url(store: DatasetStore, name: str, url: str,
             except queue.Empty:
                 break
         t.join(timeout=5.0)
+        pool.shutdown(wait=True, cancel_futures=True)
     store.finish(name)
+
+
+def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
+              fields: Optional[List[str]], abs_off: int, cfg) -> None:
+    """Split the byte stream into row-aligned blocks, parse them on the
+    pool, append + commit in source order."""
+    from collections import deque
+
+    buf = bytearray()
+    eof = False
+    pending = deque()            # (future, src_end, block_len)
+    max_inflight = n_threads + 2
+    pending_bytes = 0
+    commit_every = cfg.ingest_commit_bytes
+    target = None                # block byte size; set once header is known
+
+    def drain_one() -> None:
+        nonlocal pending_bytes
+        fut, src_end, _ = pending.popleft()
+        parsed = fut.result()
+        pending_bytes += _append_parsed(ds, parsed, src_end)
+        if cfg.persist and (not commit_every
+                            or pending_bytes >= commit_every):
+            store.save(name)
+            pending_bytes = 0
+
+    def read_more() -> bool:
+        nonlocal eof
+        if eof:
+            return False
+        item = chunks_q.get()
+        if item is None:
+            eof = True
+            return False
+        if isinstance(item, Exception):
+            raise item
+        buf.extend(item)
+        return True
+
+    # -- header (fresh ingest only): first line names the columns ---------
+    if fields is None:
+        while b"\n" not in buf and read_more():
+            pass
+        nl = buf.find(b"\n")
+        if nl < 0:
+            if not buf.strip():
+                return              # empty source, zero-row dataset
+            nl = len(buf) - 1       # header-only file without newline
+        header = bytes(buf[:nl + 1])
+        del buf[:nl + 1]
+        abs_off += len(header)
+        text = header.decode("utf-8", errors="replace").strip("\r\n﻿")
+        fields = next(csv.reader([text]))
+
+    approx_row = max(32, len(",".join(fields)) + 8)
+    target = max(cfg.ingest_chunk_rows * approx_row, 1 << 12)
+
+    # -- split / parse / commit loop --------------------------------------
+    while True:
+        while len(buf) < target and read_more():
+            pass
+        if not buf:
+            break
+        # Cut at the last complete record inside the target window (not in
+        # the whole buffer — a fast source can deliver far more than one
+        # block's worth before the first cut).
+        cut = _record_split(buf, min(target, len(buf)), cfg)
+        if cut < 0:
+            if len(buf) > target:
+                # record longer than target: search the whole buffer
+                cut = _record_split(buf, len(buf), cfg)
+            if cut < 0:
+                if eof:
+                    if buf.strip():
+                        # torn final record (no trailing newline)
+                        cut = len(buf) - 1
+                    else:
+                        break
+                else:
+                    target *= 2  # giant quoted record: widen the window
+                    continue
+        block = bytes(buf[:cut + 1])
+        del buf[:cut + 1]
+        abs_off += len(block)
+        # All-blank blocks parse to zero rows and append as no-ops, so no
+        # content check is needed here (bytes.strip() on a 12 MB block is
+        # measurable main-thread time).
+        pending.append((pool.submit(_parse_block, block, fields, cfg),
+                        abs_off, len(block)))
+        while len(pending) >= max_inflight:
+            drain_one()
+        if eof and not buf:
+            break
+    while pending:
+        drain_one()
+    if cfg.persist:
+        store.save(name)
 
 
 def parse_csv_chunks(fileobj, chunk_rows: int, cfg=None):

@@ -12,10 +12,13 @@ microservices actually used Mongo for (SURVEY.md §1/L4):
 - value-count aggregation for histograms (histogram.py:49-74) — here a
   vectorized method instead of a Mongo ``$group`` pipeline.
 
-Queries support the Mongo-query subset the reference's docs exercise
-(equality and ``$gt/$gte/$lt/$lte/$ne/$in``) evaluated vectorized over
-columns. Persistence is parquet + metadata.json per dataset under
-``settings.store_root`` — the durability tier replacing Mongo volumes.
+Queries support the Mongo operator set a reference client could reach by
+passing JSON straight to ``find()`` (reference database.py:44-48): equality,
+``$gt/$gte/$lt/$lte/$ne/$eq/$in/$nin/$exists/$regex/$not``, the logical
+combinators ``$and/$or/$nor``, and dotted paths into nested documents —
+evaluated vectorized over columns. Persistence is parquet + metadata.json
+per dataset under ``settings.store_root`` — the durability tier replacing
+Mongo volumes.
 """
 
 from __future__ import annotations
@@ -89,6 +92,9 @@ class DatasetStore:
         #: per dataset — keeps per-save mirroring O(delta) and detects
         #: journal replacement across rewrites/restarts.
         self._mirror_state: Dict[str, tuple] = {}
+        #: Interrupted source-URL ingests found by the last load_all
+        #: (resume_ingests=True) — the serving layer resubmits these.
+        self.resumable_ingests: List[str] = []
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -210,17 +216,36 @@ class DatasetStore:
     def _query_indices(cols, fields: List[str],
                        query: Dict[str, Any]) -> np.ndarray:
         n = len(next(iter(cols.values()))) if cols else 0
-        mask = np.ones(n, dtype=bool)
-        for field, cond in query.items():
+
+        def resolve(field: str):
             if field == "_id":
-                vals = np.arange(1, n + 1)
-            elif field in cols:
+                return np.arange(1, n + 1), np.ones(n, dtype=bool)
+            if field in cols:
                 vals = cols[field]
-            else:
-                mask[:] = False
-                break
-            mask &= _eval_cond(vals, cond)
-        return np.nonzero(mask)[0]
+                if vals.dtype == object:
+                    exists = np.array([v is not None for v in vals],
+                                      dtype=bool)
+                elif vals.dtype.kind == "f":
+                    exists = ~np.isnan(vals)
+                else:
+                    exists = np.ones(n, dtype=bool)
+                return vals, exists
+            if "." in field:
+                # Dotted path into an object column of nested documents
+                # (Mongo path traversal; flat CSV columns rarely hit this,
+                # but query parity requires it).
+                root, rest = field.split(".", 1)
+                if root in cols and cols[root].dtype == object:
+                    out = np.empty(n, dtype=object)
+                    exists = np.zeros(n, dtype=bool)
+                    for i, v in enumerate(cols[root]):
+                        got, ok = _traverse(v, rest)
+                        out[i] = got
+                        exists[i] = ok
+                    return out, exists
+            return np.full(n, None, dtype=object), np.zeros(n, dtype=bool)
+
+        return np.nonzero(_eval_query_mask(query, resolve, n))[0]
 
     # -- aggregation ---------------------------------------------------------
 
@@ -395,7 +420,10 @@ class DatasetStore:
         else:
             data_path = os.path.join(path, "data.parquet")
             if os.path.isfile(data_path):
-                table = pq.read_table(data_path)
+                # Single-threaded read: see read_chunk_parquet's note on
+                # pyarrow's IO pool segfaulting in jax-loaded processes.
+                table = pq.read_table(data_path, use_threads=False,
+                                      pre_buffer=False)
                 columns: Columns = {
                     fname: table.column(fname).to_numpy(zero_copy_only=False)
                     for fname in table.column_names}
@@ -408,7 +436,7 @@ class DatasetStore:
             self._datasets[name] = ds
         return ds
 
-    def load_all(self) -> List[str]:
+    def load_all(self, resume_ingests: bool = False) -> List[str]:
         """Recover the catalog from disk at startup (crash resume).
 
         If a replica root is configured, datasets present there but missing
@@ -419,7 +447,12 @@ class DatasetStore:
         Datasets recovered with ``finished: false`` were mid-job when the
         process died; their jobs are gone, so they are marked failed —
         every dataset reaches a terminal state across restarts (the
-        reference left finished:false forever, SURVEY.md §5).
+        reference left finished:false forever, SURVEY.md §5). Exception:
+        with ``resume_ingests``, interrupted *source-URL ingests* are left
+        unfinished and listed in ``resumable_ingests`` — their journaled
+        chunks carry source byte offsets, so the serving layer restarts
+        them from the last committed byte (catalog/ingest.py
+        ``resume_ingest``) instead of failing a 99%-done load.
         """
         root = self.cfg.store_root
         if self.cfg.replica_root and os.path.isdir(self.cfg.replica_root):
@@ -437,9 +470,16 @@ class DatasetStore:
                 if os.path.isfile(os.path.join(root, name, "metadata.json")):
                     self.load(name)
                     loaded.append(name)
+        self.resumable_ingests: List[str] = []
         for name in loaded:
             ds = self.get(name)
             if not ds.metadata.finished and not ds.metadata.error:
+                if (resume_ingests and ds.metadata.url
+                        and not ds.metadata.parent
+                        and (ds.num_rows == 0
+                             or ds.resume_offset is not None)):
+                    self.resumable_ingests.append(name)
+                    continue
                 self.fail(name, "interrupted: server restarted mid-job")
         return loaded
 
@@ -460,6 +500,12 @@ def _parse_journal_bytes(data: bytes) -> List[Dict[str, Any]]:
 
 
 # -- query evaluation --------------------------------------------------------
+#
+# The reference's read API passed the client's JSON query verbatim into
+# pymongo's ``find()`` (database_api_image/database.py:44-48), so the whole
+# Mongo operator set was reachable. This section reproduces that contract
+# as vectorized mask evaluation: one shared evaluator serves both column
+# queries (arrays of length n) and single-document matches (length-1).
 
 _OPS = {
     "$gt": lambda v, x: v > x,
@@ -469,7 +515,25 @@ _OPS = {
     "$ne": lambda v, x: v != x,
     "$eq": lambda v, x: v == x,
     "$in": lambda v, x: np.isin(v, x),
+    "$nin": lambda v, x: ~np.isin(v, x),
 }
+
+#: Operators whose Mongo semantics MATCH documents missing the field
+#: ($ne/$nin match absent values; comparisons and $in/$regex don't).
+_MATCH_MISSING = {"$ne", "$nin"}
+
+_REGEX_FLAGS = {"i": re.IGNORECASE, "m": re.MULTILINE, "s": re.DOTALL,
+                "x": re.VERBOSE}
+
+
+def _traverse(value: Any, path: str):
+    """Walk a dotted path inside a nested document; returns (value, found)."""
+    for part in path.split("."):
+        if isinstance(value, dict) and part in value:
+            value = value[part]
+        else:
+            return None, False
+    return value, True
 
 
 def _apply_op(op: str, vals: np.ndarray, operand: Any) -> np.ndarray:
@@ -490,25 +554,97 @@ def _apply_op(op: str, vals: np.ndarray, operand: Any) -> np.ndarray:
         return np.asarray(fn(vals, operand), dtype=bool)
 
 
-def _eval_cond(vals: np.ndarray, cond: Any) -> np.ndarray:
-    if isinstance(cond, dict):
+def _apply_regex(vals: np.ndarray, pattern: str, options: str) -> np.ndarray:
+    flags = 0
+    for ch in options or "":
+        flags |= _REGEX_FLAGS.get(ch, 0)
+    rx = re.compile(pattern, flags)
+    out = np.zeros(len(vals), dtype=bool)
+    for i, v in enumerate(vals):
+        if isinstance(v, str):
+            out[i] = rx.search(v) is not None
+        elif isinstance(v, np.str_):
+            out[i] = rx.search(str(v)) is not None
+    return out
+
+
+def _eval_cond(vals: np.ndarray, exists: np.ndarray, cond: Any) -> np.ndarray:
+    """Evaluate one field condition (scalar equality or operator document)
+    against resolved values + an existence mask."""
+    if isinstance(cond, dict) and any(k.startswith("$") for k in cond):
         mask = np.ones(len(vals), dtype=bool)
         for op, operand in cond.items():
-            if op not in _OPS:
+            if op == "$exists":
+                mask &= exists if operand else ~exists
+            elif op == "$not":
+                # $not negates the operator expression and matches docs
+                # missing the field (Mongo semantics).
+                mask &= ~_eval_cond(vals, exists, operand)
+            elif op == "$regex":
+                mask &= _apply_regex(vals.astype(object), operand,
+                                     cond.get("$options", ""))
+            elif op == "$options":
+                continue  # consumed by $regex
+            elif op == "$eq" and operand is None:
+                mask &= ~exists          # null equality matches null/missing
+            elif op == "$ne" and operand is None:
+                mask &= exists
+            elif op in _OPS:
+                m = _apply_op(op, vals, operand)
+                has_null = (op in ("$in", "$nin")
+                            and isinstance(operand, (list, tuple))
+                            and None in operand)
+                if op in _MATCH_MISSING:
+                    # $nin [..., null]: null IS in the list, so null/missing
+                    # values are excluded rather than matched.
+                    m = (m & exists) if has_null else (m | ~exists)
+                else:
+                    # $in [..., null] matches null/missing (Mongo null-in-
+                    # array semantics); plain comparisons require presence.
+                    m = (m | ~exists) if has_null else (m & exists)
+                mask &= m
+            else:
                 raise ValueError(f"unsupported query operator: {op}")
-            mask &= _apply_op(op, vals, operand)
         return mask
-    return _apply_op("$eq", vals, cond)
+    if cond is None:
+        # {field: null} matches documents where the field is null OR
+        # missing (Mongo semantics; NaN/None cells count as missing here).
+        return ~exists
+    # Scalar (or literal-document) equality: field must exist and equal.
+    return _apply_op("$eq", vals, cond) & exists
+
+
+def _eval_query_mask(query: Dict[str, Any], resolve, n: int) -> np.ndarray:
+    """Evaluate a full query document: implicit AND of field conditions and
+    the $and/$or/$nor combinators. ``resolve(field) -> (vals, exists)``."""
+    mask = np.ones(n, dtype=bool)
+    for key, cond in query.items():
+        if key in ("$and", "$or", "$nor"):
+            if not isinstance(cond, (list, tuple)) or not cond:
+                raise ValueError(f"{key} requires a non-empty array")
+            subs = [_eval_query_mask(q, resolve, n) for q in cond]
+            if key == "$and":
+                sub = np.logical_and.reduce(subs)
+            else:
+                sub = np.logical_or.reduce(subs)
+                if key == "$nor":
+                    sub = ~sub
+            mask &= sub
+        elif key.startswith("$"):
+            raise ValueError(f"unsupported top-level operator: {key}")
+        else:
+            vals, exists = resolve(key)
+            mask &= _eval_cond(vals, exists, cond)
+    return mask
 
 
 def _doc_matches(doc: Dict[str, Any], query: Dict[str, Any]) -> bool:
-    for field, cond in query.items():
-        if field not in doc:
-            return False
-        val = np.asarray([doc[field]], dtype=object)
-        try:
-            if not _eval_cond(val, cond)[0]:
-                return False
-        except TypeError:
-            return False
-    return True
+    def resolve(field: str):
+        val, found = _traverse(doc, field)
+        return (np.asarray([val], dtype=object),
+                np.asarray([found], dtype=bool))
+
+    try:
+        return bool(_eval_query_mask(query, resolve, 1)[0])
+    except TypeError:
+        return False

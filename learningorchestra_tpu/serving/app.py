@@ -32,7 +32,7 @@ from learningorchestra_tpu.ops.projection import create_projection
 from learningorchestra_tpu.parallel import distributed
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
 from learningorchestra_tpu.serving.http import (
-    FileResponse, HttpError, Router, Server)
+    FileResponse, HtmlResponse, HttpError, Router, Server)
 from learningorchestra_tpu.viz.service import (
     ImageExists, ImageNotFound, ImageService, create_embedding_image)
 
@@ -42,9 +42,20 @@ class App:
         self.cfg = cfg or global_settings
         self.store = DatasetStore(self.cfg)
         if recover and self.cfg.persist:
-            self.store.load_all()
+            self.store.load_all(resume_ingests=True)
         self.runtime = MeshRuntime(self.cfg)
         self.jobs = JobManager(self.store)
+        # Interrupted ingests restart from their last journal-committed
+        # source byte instead of failing (the reference restarted a crashed
+        # ingest from zero — or rather, never: finished stayed false
+        # forever, SURVEY.md §5).
+        for rname in self.store.resumable_ingests:
+            from learningorchestra_tpu.catalog.ingest import resume_ingest
+
+            self.jobs.submit(
+                "ingest_resume", rname,
+                lambda rname=rname: resume_ingest(self.store, rname,
+                                                  self.cfg))
         self.builder = ModelBuilder(self.store, self.runtime, self.cfg)
         self.images = {m: ImageService(m, self.cfg) for m in ("tsne", "pca")}
         self.router = Router()
@@ -251,6 +262,19 @@ class App:
         @self._route("GET", "/jobs")
         def jobs(_req):
             return 200, app.jobs.records()
+
+        @self._route("GET", "/status")
+        def status_page(_req):
+            # HTML operator view of the same data /cluster, /jobs and
+            # /files serve — the reference's Swarm visualizer equivalent
+            # (docker-compose.yml:109-121).
+            from learningorchestra_tpu.serving.status_page import (
+                render_status)
+
+            info = distributed.process_info()
+            info["mesh"] = dict(app.runtime.mesh.shape)
+            return 200, HtmlResponse(render_status(
+                info, app.jobs.records(), app.store.metadata_docs()))
 
         @self._route("GET", "/metrics")
         def metrics(_req):

@@ -57,6 +57,16 @@ class FileResponse:
         self.content_type = content_type
 
 
+class HtmlResponse:
+    """An HTML page body — the cluster status view (the stand-in for the
+    reference's dockersamples/visualizer on :80, docker-compose.yml:109-121)
+    is the only non-JSON, non-file surface."""
+
+    def __init__(self, html: str, status: int = 200):
+        self.html = html
+        self.status = status
+
+
 class Router:
     def __init__(self):
         self._routes: List[Tuple[str, re.Pattern, Callable]] = []
@@ -121,12 +131,22 @@ def _make_handler(router: Router):
             self.end_headers()
             self.wfile.write(data)
 
+        def _send_html(self, resp: HtmlResponse) -> None:
+            data = resp.html.encode()
+            self.send_response(resp.status)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def _handle(self, method: str) -> None:
             try:
                 body = self._read_body()
                 status, payload = router.dispatch(method, self.path, body)
                 if isinstance(payload, FileResponse):
                     self._send_file(payload)
+                elif isinstance(payload, HtmlResponse):
+                    self._send_html(payload)
                 else:
                     self._send_json(status, payload)
             except HttpError as e:

@@ -1,0 +1,105 @@
+"""Cluster status page — the HTML view of /cluster + /jobs + /files.
+
+The reference ships a live Docker Swarm visualizer on port 80
+(docker-compose.yml:109-121) so operators can see cluster topology and
+task placement in a browser. Here the equivalent operator surface is one
+self-refreshing HTML page over the same data the JSON routes serve:
+process/mesh topology, the job ledger, and the dataset catalog. No
+JavaScript framework, no assets — a single stdlib-rendered page, because
+the deployment story is "one binary" (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from html import escape
+from typing import Any, Dict, List
+
+_STYLE = """
+body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a2e;
+       background: #f7f7fb; }
+h1 { font-size: 1.4rem; } h2 { font-size: 1.1rem; margin-top: 1.6rem; }
+table { border-collapse: collapse; width: 100%; background: #fff;
+        box-shadow: 0 1px 2px rgba(0,0,0,.08); }
+th, td { text-align: left; padding: .35rem .6rem; font-size: .85rem;
+         border-bottom: 1px solid #e8e8ef; }
+th { background: #eceff6; }
+.badge { display: inline-block; padding: .1rem .45rem; border-radius: .6rem;
+         font-size: .75rem; color: #fff; }
+.done { background: #2e7d32; } .failed { background: #c62828; }
+.running { background: #1565c0; } .queued { background: #8d6e63; }
+.kv { display: inline-block; margin-right: 1.2rem; }
+.kv b { color: #444; }
+"""
+
+_STATUS_CLASS = {"done": "done", "failed": "failed",
+                 "running": "running", "queued": "queued"}
+
+
+def _badge(status: str) -> str:
+    cls = _STATUS_CLASS.get(status, "queued")
+    return f'<span class="badge {cls}">{escape(status)}</span>'
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    head = "".join(f"<th>{escape(h)}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{c}</td>" for c in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
+                  datasets: List[Dict[str, Any]],
+                  refresh_seconds: int = 5) -> str:
+    """Render the operator page. Inputs are exactly what the JSON routes
+    return, so the page can never disagree with the API."""
+    mesh = cluster.get("mesh") or {}
+    mesh_txt = " × ".join(f"{escape(str(k))}={escape(str(v))}"
+                          for k, v in mesh.items()) or "—"
+    cluster_kvs = "".join(
+        f'<span class="kv"><b>{escape(str(k))}</b> {escape(str(v))}</span>'
+        for k, v in cluster.items() if k != "mesh")
+
+    job_rows = []
+    for j in sorted(jobs, key=lambda j: j.get("started_at", 0),
+                    reverse=True):
+        job_rows.append([
+            escape(str(j.get("job_id", ""))),
+            escape(str(j.get("kind", ""))),
+            escape(str(j.get("dataset", ""))),
+            _badge(str(j.get("status", ""))),
+            escape(f"{j['duration']:.1f}"
+                   if j.get("duration") is not None else ""),
+            escape(str(j.get("error") or "")),
+        ])
+
+    ds_rows = []
+    for d in sorted(datasets, key=lambda d: str(d.get("filename", ""))):
+        state = ("failed" if d.get("error")
+                 else "done" if d.get("finished") else "running")
+        ds_rows.append([
+            escape(str(d.get("filename", ""))),
+            escape(str(d.get("parent_filename") or d.get("url") or "")),
+            _badge(state),
+            escape(str(len(d.get("fields") or []))),
+            escape(str(d.get("error") or "")),
+        ])
+
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<meta http-equiv="refresh" content="{refresh_seconds}">
+<title>learningorchestra-tpu cluster</title>
+<style>{_STYLE}</style></head>
+<body>
+<h1>learningorchestra-tpu — cluster status</h1>
+<p>{cluster_kvs}<span class="kv"><b>mesh</b> {mesh_txt}</span></p>
+<h2>Jobs ({len(jobs)})</h2>
+{_table(["job", "kind", "target datasets", "status", "runtime (s)",
+         "error"], job_rows)}
+<h2>Datasets ({len(datasets)})</h2>
+{_table(["name", "origin", "state", "fields", "error"], ds_rows)}
+<p style="color:#888;font-size:.75rem">auto-refreshes every
+{refresh_seconds}s — JSON at <a href="/cluster">/cluster</a>,
+<a href="/jobs">/jobs</a>, <a href="/files">/files</a>,
+<a href="/metrics">/metrics</a></p>
+</body></html>"""

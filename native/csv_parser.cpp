@@ -4,14 +4,29 @@
 // CSV line into a dict, one Mongo insert per row (reference
 // database_api_image/database.py:156-181). This framework's native tier is
 // first-party C++ (the reference's native horsepower was the external Spark
-// JVM — SURVEY.md §2): a single-pass, RFC-4180-aware tokenizer that
-// classifies each column as numeric or string and materializes numeric
-// columns directly into contiguous double buffers that numpy adopts without
-// copying per cell. Exposed as a C ABI for ctypes
-// (learningorchestra_tpu/catalog/native.py).
+// JVM — SURVEY.md §2): a single-pass, RFC-4180-aware tokenizer built for
+// throughput on the machines ingest actually runs on (often one core, disk
+// at ~150 MB/s — every ms of CPU per MB is throughput lost):
+//
+//   - numeric columns parse straight to doubles with std::from_chars and
+//     store NOTHING else — no spans, no strings. If a column turns out to
+//     be non-numeric mid-block (rare), the block is re-tokenized once for
+//     that column only;
+//   - string columns record (offset, length) spans into one owned copy of
+//     the input block; quoted cells needing unescape go to a side arena;
+//   - string columns finalize into Arrow-layout buffers (int32 offsets,
+//     contiguous UTF-8 data, LSB validity bitmap) that Python adopts
+//     ZERO-COPY via pa.foreign_buffer — the parse handle stays alive as
+//     the buffers' owner until the Python batch is dropped.
+//
+// Exposed as a C ABI for ctypes (learningorchestra_tpu/catalog/native.py).
 //
 // Build: make -C native   (g++ -O3 -shared -fPIC)
 
+#include <emmintrin.h>
+
+#include <charconv>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -20,76 +35,264 @@
 
 namespace {
 
+// Span flag: offset's high bit selects the unescape arena over the input
+// buffer. Blocks are bounded (the Python splitter caps them well under
+// 2 GiB), so 31 offset bits suffice.
+constexpr uint32_t kArenaBit = 0x80000000u;
+
 struct Column {
   std::string name;
+  // Cell spans, one per row — string columns only (numeric columns store
+  // no per-cell state beyond the parsed double).
+  std::vector<uint32_t> span_off;
+  std::vector<uint32_t> span_len;
+  std::vector<double> f64;
   bool numeric = true;
-  std::vector<double> nums;           // valid when numeric
-  std::vector<std::string> strs;      // always filled (fallback storage)
+  bool has_nan = false;
+  bool all_int = true;
+  // Finalized representation.
+  int kind = 0;  // 0 = float64, 1 = int64, 2 = string
+  std::vector<int64_t> i64;
+  std::vector<int32_t> offsets;   // nrows + 1 (string cols)
+  std::string strdata;            // concatenated UTF-8 (string cols)
+  std::vector<uint8_t> validity;  // LSB-first bitmap (string cols)
 };
 
 struct Table {
+  std::string buf;    // owned copy of the input block
+  std::string arena;  // unescaped quoted cells
   std::vector<Column> cols;
   int64_t nrows = 0;
+  size_t body_start = 0;  // first byte after the header record
 };
 
-// Parse one CSV record starting at p (end at stop). Appends cell strings to
-// out. Returns pointer past the record's newline (or stop). Handles quoted
-// fields with embedded commas/newlines and doubled-quote escapes.
-const char* parse_record(const char* p, const char* stop,
-                         std::vector<std::string>& out) {
-  std::string cell;
-  bool in_quotes = false;
-  for (;;) {
-    if (p == stop) {
-      out.push_back(cell);
-      return p;
-    }
-    char c = *p;
-    if (in_quotes) {
+// Integers outside ±2^53 lose precision as doubles; such columns stay f64.
+constexpr double kMaxExactInt = 9007199254740992.0;
+
+bool parse_double(const char* s, size_t len, double* out) {
+  if (len == 0) {
+    *out = std::nan("");
+    return true;
+  }
+  auto [ptr, ec] = std::from_chars(s, s + len, *out);
+  if (ec == std::errc() && ptr == s + len) return true;
+  // from_chars rejects leading '+', leading/trailing spaces; strtod path.
+  std::string tmp(s, len);
+  char* end = nullptr;
+  double v = std::strtod(tmp.c_str(), &end);
+  while (*end == ' ') ++end;
+  if (end == tmp.c_str() || *end != '\0') return false;
+  *out = v;
+  return true;
+}
+
+// Delimiter that ended a cell.
+enum CellEnd { kComma, kNewline, kEof };
+
+// SSE2 scan to the first of {',', '\n', '\r', '"'} — the unquoted-cell
+// hot loop. 16 bytes per iteration instead of one.
+inline const char* scan_delims(const char* p, const char* end) {
+  const __m128i c1 = _mm_set1_epi8(',');
+  const __m128i c2 = _mm_set1_epi8('\n');
+  const __m128i c3 = _mm_set1_epi8('\r');
+  const __m128i c4 = _mm_set1_epi8('"');
+  while (p + 16 <= end) {
+    __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i*>(p));
+    __m128i m = _mm_or_si128(
+        _mm_or_si128(_mm_cmpeq_epi8(v, c1), _mm_cmpeq_epi8(v, c2)),
+        _mm_or_si128(_mm_cmpeq_epi8(v, c3), _mm_cmpeq_epi8(v, c4)));
+    int mask = _mm_movemask_epi8(m);
+    if (mask) return p + __builtin_ctz(mask);
+    p += 16;
+  }
+  while (p < end && *p != ',' && *p != '\n' && *p != '\r' && *p != '"') ++p;
+  return p;
+}
+
+// Scan one cell starting at buf[pos]: sets (off, len) — off flagged with
+// kArenaBit when the unescaped value lives in the arena — and returns the
+// position just past the cell's delimiter via pos. Shared by the main
+// parse loop and the demote re-tokenizer so both see identical cells.
+inline CellEnd scan_cell(const std::string& buf, size_t& pos,
+                         std::string& arena, uint32_t* off, uint32_t* len) {
+  const char* data = buf.data();
+  const size_t n = buf.size();
+  if (pos < n && data[pos] == '"') {  // quoted: unescape into the arena
+    size_t start = arena.size();
+    ++pos;
+    while (pos < n) {
+      char c = data[pos];
       if (c == '"') {
-        if (p + 1 < stop && p[1] == '"') {  // escaped quote
-          cell.push_back('"');
-          p += 2;
+        if (pos + 1 < n && data[pos + 1] == '"') {
+          arena.push_back('"');
+          pos += 2;
         } else {
-          in_quotes = false;
-          ++p;
+          ++pos;
+          break;
         }
       } else {
-        cell.push_back(c);
-        ++p;
+        arena.push_back(c);
+        ++pos;
       }
-    } else if (c == '"') {
-      in_quotes = true;
-      ++p;
-    } else if (c == ',') {
-      out.push_back(cell);
-      cell.clear();
-      ++p;
-    } else if (c == '\n' || c == '\r') {
-      if (c == '\r' && p + 1 < stop && p[1] == '\n') ++p;
-      ++p;
-      out.push_back(cell);
-      return p;
-    } else {
-      cell.push_back(c);
-      ++p;
     }
+    *off = static_cast<uint32_t>(start) | kArenaBit;
+    *len = static_cast<uint32_t>(arena.size() - start);
+    // Skip to the delimiter (tolerate stray bytes after the close quote).
+    while (pos < n && data[pos] != ',' && data[pos] != '\n' &&
+           data[pos] != '\r')
+      ++pos;
+  } else {
+    size_t start = pos;
+    const char* p = data + pos;
+    const char* end = data + n;
+    for (;;) {
+      p = scan_delims(p, end);
+      if (p < end && *p == '"') {  // mid-cell quote: content, keep going
+        ++p;
+        continue;
+      }
+      break;
+    }
+    pos = static_cast<size_t>(p - data);
+    *off = static_cast<uint32_t>(start);
+    *len = static_cast<uint32_t>(pos - start);
+  }
+  if (pos >= n) return kEof;
+  char c = data[pos];
+  if (c == ',') {
+    ++pos;
+    return kComma;
+  }
+  if (c == '\r') {
+    ++pos;
+    if (pos < n && data[pos] == '\n') ++pos;
+    return kNewline;
+  }
+  ++pos;  // '\n'
+  return kNewline;
+}
+
+const char* span_ptr(const Table& t, uint32_t off) {
+  return (off & kArenaBit) ? t.arena.data() + (off & ~kArenaBit)
+                           : t.buf.data() + off;
+}
+
+// A numeric column hit a non-numeric cell at row `upto` (0-based): walk the
+// block again collecting ONLY column c's spans for rows 0..upto-1. Runs at
+// most once per demoted column, so the hot path never stores spans for
+// numeric data.
+void retokenize_column(Table* t, size_t target_col, int64_t upto) {
+  Column& col = t->cols[target_col];
+  col.span_off.reserve(upto + 1);
+  col.span_len.reserve(upto + 1);
+  const std::string& buf = t->buf;
+  size_t pos = t->body_start;
+  const size_t width = t->cols.size();
+  for (int64_t row = 0; row < upto;) {
+    if (pos >= buf.size()) break;
+    char c = buf[pos];
+    if (c == '\n' || c == '\r') {  // blank line (skipped by main loop too)
+      ++pos;
+      continue;
+    }
+    uint32_t off = 0, len = 0;
+    CellEnd end = kNewline;
+    bool got = false;
+    for (size_t ci = 0; ci < width; ++ci) {
+      end = scan_cell(buf, pos, t->arena, &off, &len);
+      if (ci == target_col) {
+        col.span_off.push_back(off);
+        col.span_len.push_back(len);
+        got = true;
+      }
+      if (end != kComma) break;
+    }
+    if (!got) {  // ragged row: column absent → empty cell
+      col.span_off.push_back(0);
+      col.span_len.push_back(0);
+    }
+    // Consume any extra cells beyond width.
+    while (end == kComma) end = scan_cell(buf, pos, t->arena, &off, &len);
+    ++row;
   }
 }
 
-// strtod-based full-string numeric check; empty cells are NaN (missing).
-bool to_double(const std::string& s, double* out) {
-  if (s.empty()) {
-    *out = std::strtod("nan", nullptr);
-    return true;
+inline void process_cell(Table* t, size_t c, uint32_t off, uint32_t len) {
+  Column& col = t->cols[c];
+  if (col.numeric) {
+    double v;
+    if (parse_double(span_ptr(*t, off), len, &v)) {
+      col.f64.push_back(v);
+      if (std::isnan(v)) {
+        col.has_nan = true;
+      } else if (col.all_int &&
+                 (v != std::floor(v) || std::fabs(v) >= kMaxExactInt)) {
+        col.all_int = false;
+      }
+      return;
+    }
+    // Demote: collect the spans the fast path never stored.
+    col.numeric = false;
+    col.f64.clear();
+    col.f64.shrink_to_fit();
+    retokenize_column(t, c, t->nrows);
   }
-  const char* c = s.c_str();
-  char* end = nullptr;
-  double v = std::strtod(c, &end);
-  while (*end == ' ') ++end;
-  if (end == c || *end != '\0') return false;
-  *out = v;
-  return true;
+  col.span_off.push_back(off);
+  col.span_len.push_back(len);
+}
+
+void finalize(Table* t) {
+  const int64_t n = t->nrows;
+  for (auto& col : t->cols) {
+    if (col.numeric && n > 0) {
+      if (!col.has_nan && col.all_int) {
+        col.kind = 1;
+        col.i64.resize(n);
+        for (int64_t i = 0; i < n; ++i)
+          col.i64[i] = static_cast<int64_t>(col.f64[i]);
+      } else {
+        col.kind = 0;
+      }
+      continue;
+    }
+    if (col.numeric) {  // zero rows: default float64
+      col.kind = 0;
+      continue;
+    }
+    col.kind = 2;
+    size_t total = 0;
+    for (int64_t i = 0; i < n; ++i) total += col.span_len[i];
+    col.strdata.reserve(total);
+    col.offsets.resize(n + 1);
+    col.validity.assign((n + 7) / 8, 0);
+    col.offsets[0] = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t len = col.span_len[i];
+      if (len) {
+        col.strdata.append(span_ptr(*t, col.span_off[i]), len);
+        col.validity[i >> 3] |= static_cast<uint8_t>(1u << (i & 7));
+      }
+      col.offsets[i + 1] = static_cast<int32_t>(col.strdata.size());
+    }
+  }
+  // The handle outlives the parse as the zero-copy buffers' owner (Python
+  // drops it when the RecordBatch dies), so free everything the finalized
+  // representation no longer references: the input copy, the arena, the
+  // spans, and the f64 scratch of int64 columns.
+  t->buf.clear();
+  t->buf.shrink_to_fit();
+  t->arena.clear();
+  t->arena.shrink_to_fit();
+  for (auto& col : t->cols) {
+    col.span_off.clear();
+    col.span_off.shrink_to_fit();
+    col.span_len.clear();
+    col.span_len.shrink_to_fit();
+    if (col.kind == 1) {
+      col.f64.clear();
+      col.f64.shrink_to_fit();
+    }
+  }
 }
 
 }  // namespace
@@ -97,56 +300,69 @@ bool to_double(const std::string& s, double* out) {
 extern "C" {
 
 // Parse a CSV byte buffer. Returns an opaque Table* (NULL on failure).
-void* lo_csv_parse(const char* data, size_t len, int has_header) {
-  const char* p = data;
-  const char* stop = data + len;
-  auto* table = new Table();
+// ncols_hint (headerless mode only): the caller-known column count —
+// every record pads/truncates to it, exactly like a header would force.
+// 0 = infer the width from the first record (whole-buffer callers).
+void* lo_csv_parse(const char* data, size_t len, int has_header,
+                   int ncols_hint) {
+  auto* t = new Table();
+  t->buf.assign(data, len);
+  const std::string& buf = t->buf;
 
-  std::vector<std::string> cells;
+  size_t pos = 0;
+  uint32_t off = 0, clen = 0;
   if (has_header) {
-    if (p == stop) { delete table; return nullptr; }
-    p = parse_record(p, stop, cells);
-    for (auto& name : cells) {
+    if (len == 0) {
+      delete t;
+      return nullptr;
+    }
+    CellEnd end;
+    do {
+      end = scan_cell(t->buf, pos, t->arena, &off, &clen);
       Column col;
-      col.name = name;
-      table->cols.push_back(std::move(col));
+      col.name.assign(span_ptr(*t, off), clen);
+      t->cols.push_back(std::move(col));
+    } while (end == kComma);
+  } else if (ncols_hint > 0) {
+    for (int i = 0; i < ncols_hint; ++i) {
+      Column col;
+      col.name = "c" + std::to_string(i);
+      t->cols.push_back(std::move(col));
     }
   }
+  t->body_start = pos;
 
-  size_t width = table->cols.size();
-  while (p != stop) {
-    // Skip blank lines.
-    if (*p == '\n' || *p == '\r') { ++p; continue; }
-    cells.clear();
-    p = parse_record(p, stop, cells);
-    if (width == 0) {  // headerless: synthesize c0..cN on first record
-      width = cells.size();
-      for (size_t i = 0; i < width; ++i) {
+  size_t width = t->cols.size();
+  while (pos < buf.size()) {
+    char c = buf[pos];
+    if (c == '\n' || c == '\r') {  // blank line
+      ++pos;
+      continue;
+    }
+    if (width == 0) {  // headerless: synthesize c0..cN from the first record
+      size_t probe = pos;
+      CellEnd end;
+      do {
+        end = scan_cell(t->buf, probe, t->arena, &off, &clen);
         Column col;
-        col.name = "c" + std::to_string(i);
-        table->cols.push_back(std::move(col));
-      }
+        col.name = "c" + std::to_string(t->cols.size());
+        t->cols.push_back(std::move(col));
+      } while (end == kComma);
+      width = t->cols.size();
+      t->arena.clear();  // probe may have unescaped; re-scan for real below
     }
-    if (cells.size() != width) {  // ragged row: pad/truncate to width
-      cells.resize(width);
-    }
-    for (size_t i = 0; i < width; ++i) {
-      Column& col = table->cols[i];
-      double v;
-      if (col.numeric && to_double(cells[i], &v)) {
-        col.nums.push_back(v);
-      } else if (col.numeric) {
-        // Column demoted to string: discard numeric buffer (strings were
-        // kept all along).
-        col.numeric = false;
-        col.nums.clear();
-        col.nums.shrink_to_fit();
-      }
-      col.strs.push_back(std::move(cells[i]));
-    }
-    table->nrows++;
+    size_t ci = 0;
+    CellEnd end = kNewline;
+    do {
+      end = scan_cell(t->buf, pos, t->arena, &off, &clen);
+      if (ci < width) process_cell(t, ci, off, clen);
+      ++ci;
+    } while (end == kComma);
+    for (; ci < width; ++ci) process_cell(t, ci, 0, 0);  // ragged: pad
+    t->nrows++;
   }
-  return table;
+  finalize(t);
+  return t;
 }
 
 int lo_csv_ncols(void* handle) {
@@ -161,19 +377,63 @@ const char* lo_csv_col_name(void* handle, int c) {
   return static_cast<Table*>(handle)->cols[c].name.c_str();
 }
 
-int lo_csv_col_is_numeric(void* handle, int c) {
-  return static_cast<Table*>(handle)->cols[c].numeric ? 1 : 0;
+// 0 = float64, 1 = int64, 2 = string.
+int lo_csv_col_kind(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].kind;
 }
 
-// Contiguous double buffer of a numeric column (owned by the Table).
-double* lo_csv_col_numeric(void* handle, int c) {
-  return static_cast<Table*>(handle)->cols[c].nums.data();
+const double* lo_csv_col_f64(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].f64.data();
 }
 
-const char* lo_csv_cell_str(void* handle, int c, long r) {
-  return static_cast<Table*>(handle)->cols[c].strs[r].c_str();
+const int64_t* lo_csv_col_i64(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].i64.data();
+}
+
+// Arrow string-column layout: offsets[nrows+1], UTF-8 data, LSB validity.
+const int32_t* lo_csv_col_offsets(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].offsets.data();
+}
+
+const char* lo_csv_col_strdata(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].strdata.data();
+}
+
+const uint8_t* lo_csv_col_validity(void* handle, int c) {
+  return static_cast<Table*>(handle)->cols[c].validity.data();
 }
 
 void lo_csv_free(void* handle) { delete static_cast<Table*>(handle); }
+
+// Index of the last newline that terminates a complete CSV record (even
+// quote parity), or -1 if none — the row-aligned block splitter's core,
+// run at native speed so the Python splitter never scans bytes.
+long lo_csv_record_split(const char* data, size_t len) {
+  const char* q = static_cast<const char*>(memchr(data, '"', len));
+  if (q == nullptr) {
+    // No quotes anywhere: the last newline ends a record. memrchr runs at
+    // SIMD speed — the common (unquoted-CSV) split is near-free.
+    const char* nl = static_cast<const char*>(memrchr(data, '\n', len));
+    return nl ? static_cast<long>(nl - data) : -1;
+  }
+  // Quotes present: everything before the first quote is outside quoting,
+  // so only the tail needs the parity walk.
+  long cut = -1;
+  size_t start = static_cast<size_t>(q - data);
+  {
+    const char* nl = static_cast<const char*>(memrchr(data, '\n', start));
+    if (nl) cut = static_cast<long>(nl - data);
+  }
+  bool in_quotes = false;
+  for (size_t i = start; i < len; ++i) {
+    char c = data[i];
+    if (c == '"') {
+      in_quotes = !in_quotes;
+    } else if (c == '\n' && !in_quotes) {
+      cut = static_cast<long>(i);
+    }
+  }
+  return cut;
+}
 
 }  // extern "C"

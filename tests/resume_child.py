@@ -1,0 +1,45 @@
+"""Child process for the kill -9 resumable-ingest drill (test_resume.py).
+
+Ingests a CSV with per-chunk commits and a throttled source stream so the
+parent can SIGKILL it mid-ingest with journaled chunks on disk.
+
+Usage: python resume_child.py <store_root> <csv_path>
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import learningorchestra_tpu.catalog.ingest as ing  # noqa: E402
+from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: E402
+from learningorchestra_tpu.config import Settings  # noqa: E402
+
+
+def main(store_root: str, csv_path: str) -> None:
+    cfg = Settings()
+    cfg.store_root = store_root
+    cfg.persist = True
+    cfg.ingest_chunk_rows = 500
+    cfg.ingest_commit_bytes = 0        # commit every chunk
+    cfg.ingest_parse_threads = 2
+
+    real_open = ing._open_url_stream
+
+    def throttled(url, timeout, offset=0):
+        for chunk in real_open(url, timeout, offset=offset):
+            # Re-chunk small + sleep so the ingest takes seconds and the
+            # parent's SIGKILL lands mid-flight.
+            for i in range(0, len(chunk), 8 << 10):
+                yield chunk[i:i + (8 << 10)]
+                time.sleep(0.01)
+
+    ing._open_url_stream = throttled
+    store = DatasetStore(cfg)
+    store.create("victim", url=csv_path)
+    ing.ingest_csv_url(store, "victim", csv_path, cfg)
+    print("FINISHED", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

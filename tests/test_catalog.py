@@ -89,6 +89,100 @@ def test_read_query_operators(store):
     assert [d["_id"] for d in docs] == [1, 4]
 
 
+def test_read_query_logical_operators(store):
+    """$and/$or/$nor combinators — Mongo passes these straight through
+    find() in the reference (database.py:44-48)."""
+    store.create("d", columns=_mkcols(10), finished=True)
+    docs = store.read("d", limit=20, query={
+        "$and": [{"a": {"$gte": 3}}, {"a": {"$lt": 6}}]})
+    assert [d["a"] for d in docs] == [3, 4, 5]
+    docs = store.read("d", limit=20, query={
+        "$or": [{"a": {"$lt": 2}}, {"name": "r8"}]})
+    assert [d["a"] for d in docs] == [0, 1, 8]
+    docs = store.read("d", limit=20, query={
+        "$nor": [{"a": {"$lt": 8}}, {"name": "r9"}]})
+    # The metadata doc (no 'a', no 'name') matches the $nor too — exactly
+    # what Mongo's find() would return for the reference's _id:0 doc.
+    assert docs[0]["_id"] == 0
+    assert [d["a"] for d in docs[1:]] == [8]
+    # Nested combinators
+    docs = store.read("d", limit=20, query={
+        "$or": [{"$and": [{"a": {"$gt": 1}}, {"a": {"$lt": 4}}]},
+                {"a": 9}]})
+    assert [d["a"] for d in docs] == [2, 3, 9]
+
+
+def test_read_query_not_exists_regex(store):
+    cols = {
+        "a": np.arange(6, dtype=np.int64),
+        "tag": np.array(["alpha", "beta", None, "Gamma", "alph", None],
+                        dtype=object),
+        "opt": np.array([1.0, np.nan, 3.0, np.nan, 5.0, np.nan]),
+    }
+    store.create("d", columns=cols, finished=True)
+    # $regex with and without $options (docs' query example shape)
+    docs = store.read("d", limit=20, query={"tag": {"$regex": "^alph"}})
+    assert [d["a"] for d in docs] == [0, 4]
+    docs = store.read("d", limit=20,
+                      query={"tag": {"$regex": "^gam", "$options": "i"}})
+    assert [d["a"] for d in docs] == [3]
+    # $exists — NaN/None cells count as missing (CSV empty cells)
+    docs = store.read("d", limit=20, query={"opt": {"$exists": True}})
+    assert [d["a"] for d in docs] == [0, 2, 4]
+    docs = store.read("d", limit=20, query={"tag": {"$exists": False}})
+    assert [d["a"] for d in docs if d["_id"] != 0] == [2, 5]
+    # $not negates the operator expression, matching missing fields —
+    # including the metadata doc (no 'tag' field), as Mongo would.
+    docs = store.read("d", limit=20,
+                      query={"tag": {"$not": {"$regex": "^alph"}}})
+    assert docs[0]["_id"] == 0
+    assert [d["a"] for d in docs[1:]] == [1, 2, 3, 5]
+    # $ne / $nin match documents missing the field (Mongo semantics)
+    docs = store.read("d", limit=20, query={"tag": {"$ne": "alpha"}})
+    assert [d["a"] for d in docs if d["_id"] != 0] == [1, 2, 3, 4, 5]
+    docs = store.read("d", limit=20,
+                      query={"tag": {"$nin": ["alpha", "beta"]}})
+    assert [d["a"] for d in docs if d["_id"] != 0] == [2, 3, 4, 5]
+    # Unknown operator still refuses loudly
+    with pytest.raises(ValueError):
+        store.read("d", limit=20, query={"a": {"$mod": [2, 0]}})
+    with pytest.raises(ValueError):
+        store.read("d", limit=20, query={"$where": "1"})
+
+
+def test_read_query_null_semantics(store):
+    """{field: null} matches null/missing cells (Mongo semantics) — and
+    $in/[null] / $nin/[null] follow the null-in-array rules."""
+    cols = {
+        "a": np.arange(5, dtype=np.int64),
+        "tag": np.array(["x", None, "y", None, "z"], dtype=object),
+    }
+    store.create("d", columns=cols, finished=True)
+
+    def rows(q):
+        return [d["a"] for d in store.read("d", limit=20, query=q)
+                if d["_id"] != 0]
+
+    assert rows({"tag": None}) == [1, 3]
+    assert rows({"tag": {"$eq": None}}) == [1, 3]
+    assert rows({"tag": {"$ne": None}}) == [0, 2, 4]
+    assert rows({"tag": {"$in": ["x", None]}}) == [0, 1, 3]
+    assert rows({"tag": {"$nin": [None]}}) == [0, 2, 4]
+    assert rows({"tag": {"$nin": ["x"]}}) == [1, 2, 3, 4]
+
+
+def test_read_query_missing_column_and_metadata_doc(store):
+    store.create("d", columns=_mkcols(4), finished=True,
+                 extra={"stats": {"f1": 0.9}})
+    # Missing column: equality never matches, $exists:false matches all
+    assert store.read("d", limit=20, query={"nope": 1}) == []
+    docs = store.read("d", limit=20, query={"nope": {"$exists": False}})
+    assert len(docs) == 5  # metadata doc + 4 rows
+    # Metadata doc participates via dotted path into nested extra
+    docs = store.read("d", limit=20, query={"stats.f1": {"$gt": 0.5}})
+    assert len(docs) == 1 and docs[0]["_id"] == 0
+
+
 def test_finish_and_fail_protocol(store):
     store.create("d", columns=_mkcols())
     assert store.get("d").metadata.finished is False

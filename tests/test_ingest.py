@@ -250,6 +250,29 @@ def test_native_chunked_stream_with_quoted_newlines():
     assert vals == list(range(500))
 
 
+def test_native_headerless_ragged_first_row_keeps_width():
+    """A short FIRST record in a headerless block must not shrink the
+    schema: the caller-supplied names fix the width, and rows pad to it
+    (the pandas names= behavior)."""
+    native = _native_or_skip()
+    batch = native.parse_csv_block_arrow(b"1,2\n3,4,5\n6,7,8\n",
+                                         names=["a", "b", "c"])
+    assert batch.schema.names == ["a", "b", "c"]
+    cols = {n: col.to_numpy(zero_copy_only=False)
+            for n, col in zip(batch.schema.names, batch.columns)}
+    assert cols["a"].tolist() == [1, 3, 6]
+    assert cols["c"][0] != cols["c"][0]  # padded cell -> NaN
+    assert cols["c"][1] == 5.0 and cols["c"][2] == 8.0
+
+
+def test_native_parse_bytes_headerless():
+    """has_header=False synthesizes c0..cN and keeps every data row."""
+    native = _native_or_skip()
+    cols = native.parse_csv_bytes(b"1,2\n3,4\n", has_header=False)
+    assert cols["c0"].tolist() == [1, 3]
+    assert cols["c1"].tolist() == [2, 4]
+
+
 def test_native_ingest_end_to_end(store, cfg, tmp_path):
     _native_or_skip()
     cfg.use_native_csv = True

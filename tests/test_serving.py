@@ -158,6 +158,30 @@ def test_cluster_and_jobs_routes(served):
     assert any(j["kind"] == "ingest" for j in jobs)
 
 
+def test_status_page(served):
+    """HTML operator view (reference Swarm-visualizer parity) renders the
+    same data the JSON routes serve."""
+    ctx, app, csv_path = served
+    import requests
+
+    DatabaseApi(ctx).create_file("status_probe", csv_path, wait=True)
+    r = requests.get(ctx.url("/status"))
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith("text/html")
+    html = r.text
+    assert "cluster status" in html
+    assert "status_probe" in html        # dataset table
+    assert "ingest" in html              # job ledger
+    assert 'href="/jobs"' in html
+    # Dataset names are user input — reject markup injection.
+    from learningorchestra_tpu.serving.status_page import render_status
+    page = render_status({"mesh": {}}, [], [
+        {"filename": "<script>alert(1)</script>", "finished": True,
+         "fields": []}])
+    assert "<script>alert(1)" not in page
+    assert "&lt;script&gt;" in page
+
+
 def test_async_model_build(served):
     ctx, app, csv_path = served
     db = DatabaseApi(ctx)
