@@ -5,9 +5,11 @@ runnable in this environment, so the Spark-CPU baseline is approximated by
 sklearn on the same synthetic HIGGS-shape data with the *same
 hyperparameters* our trainers default to (depth-5 trees, 20 trees/rounds,
 32 bins) — and sklearn's fast histogram GBT, so the comparison favors the
-baseline. Runs on a 1/10th subsample (1.1M rows, single core) and the
-recorded extrapolation to 11M is linear — conservative for the tree
-families, whose cost grows superlinearly.
+baseline. The workload is benchmarks/workload.py — the SAME generator
+bench.py feeds our trainers, calibrated to the published HIGGS family
+ordering (trees beat linear). Runs on a 1/10th subsample (1.1M rows,
+single core) and the recorded extrapolation to 11M is linear —
+conservative for the tree families, whose cost grows superlinearly.
 
 CPU seconds are reported as ``process_time`` (pure compute, robust to
 machine sharing). Run once; results are recorded in BASELINE.md and used
@@ -17,18 +19,14 @@ as the denominator of bench.py's ``vs_baseline``.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-
-def _higgs_like(n, d=28, seed=0):
-    rng = np.random.default_rng(seed)
-    X = rng.normal(size=(n, d)).astype(np.float32)
-    w = rng.normal(size=d).astype(np.float32)
-    y = ((X @ w + 0.5 * rng.normal(size=n)) > 0).astype(np.int32)
-    return X, y
+from benchmarks.workload import higgs_like_xy as _higgs_like  # noqa: E402
 
 
 def main(n=1_100_000):
@@ -38,7 +36,8 @@ def main(n=1_100_000):
     from sklearn.naive_bayes import GaussianNB
     from sklearn.tree import DecisionTreeClassifier
 
-    X, y = _higgs_like(n)
+    X, y = _higgs_like(n, 0)
+    X_test, y_test = _higgs_like(100_000, 1)   # held-out, same as bench.py
     models = {
         "lr": LogisticRegression(max_iter=300, n_jobs=1),
         "dt": DecisionTreeClassifier(max_depth=5),
@@ -53,7 +52,7 @@ def main(n=1_100_000):
         model.fit(X, y)
         wall, cpu = time.time() - t0, time.process_time() - c0
         total_cpu += cpu
-        acc = float((model.predict(X[:100_000]) == y[:100_000]).mean())
+        acc = float((model.predict(X_test) == y_test).mean())
         print(json.dumps({"bench": f"cpu_baseline.fit.{kind}",
                           "wall_s": round(wall, 2), "cpu_s": round(cpu, 2),
                           "acc_100k": round(acc, 4), "rows": n}), flush=True)

@@ -64,6 +64,15 @@ def _sniff_header(first_chunk: bytes, url: str) -> None:
         raise InvalidCsvUrl(f"url does not look like CSV: {url}")
 
 
+def _content_range_total(value) -> Optional[int]:
+    """Total length from a ``Content-Range: bytes */N`` (or
+    ``bytes a-b/N``) header; None when absent/opaque."""
+    if not value or "/" not in value:
+        return None
+    total = value.rsplit("/", 1)[1].strip()
+    return int(total) if total.isdigit() else None
+
+
 def _skip_bytes(chunks: Iterator[bytes], n: int) -> Iterator[bytes]:
     """Drop the first ``n`` bytes of a chunk iterator (resume fallback for
     servers that ignore Range requests)."""
@@ -88,8 +97,8 @@ def _source_identity(url: str, timeout: float) -> dict:
         if url.startswith(("http://", "https://")):
             import requests
 
-            resp = requests.head(url, timeout=timeout,
-                                 allow_redirects=True)
+            resp = requests.head(url, timeout=timeout, allow_redirects=True,
+                                 headers={"Accept-Encoding": "identity"})
             if resp.status_code >= 400:
                 return {}
             out = {}
@@ -121,18 +130,35 @@ def _open_url_stream(url: str, timeout: float,
     if url.startswith(("http://", "https://")):
         import requests
 
-        headers = {"Range": f"bytes={offset}-"} if offset else {}
+        # identity: byte offsets journal positions in the DECODED stream
+        # (iter_content gunzips transparently), but a Range request
+        # addresses the on-the-wire representation — with gzip the two
+        # disagree and a resume would splice at the wrong byte.
+        headers = {"Accept-Encoding": "identity"}
+        if offset:
+            headers["Range"] = f"bytes={offset}-"
         resp = requests.get(url, stream=True, timeout=timeout,
                             headers=headers)
         if offset and resp.status_code == 416:
-            # The source is now SHORTER than the committed offset (the
-            # offset==length case is handled before streaming starts):
-            # the content changed — refuse rather than mark a truncated
-            # dataset finished.
+            # Unsatisfiable range. RFC 7233 makes offset == total length
+            # unsatisfiable too, so a fully-committed ingest whose finish
+            # flip was lost lands here when HEAD gave no length — check
+            # the 416's Content-Range total before concluding the source
+            # shrank.
+            total = _content_range_total(resp.headers.get("Content-Range"))
+            if total is not None and total == offset:
+                return iter(())             # every byte already committed
+            if total is None:
+                # Can't tell from the 416: re-fetch in full and skip.
+                resp = requests.get(url, stream=True, timeout=timeout,
+                                    headers={"Accept-Encoding": "identity"})
+                resp.raise_for_status()
+                return _skip_bytes(
+                    resp.iter_content(chunk_size=_CHUNK_BYTES), offset)
             raise SourceChanged(
-                f"source at {url} is shorter than the committed resume "
-                f"offset {offset}; it must have changed since the "
-                "interrupted ingest")
+                f"source at {url} is {total} bytes, shorter than the "
+                f"committed resume offset {offset}; it must have changed "
+                "since the interrupted ingest")
         resp.raise_for_status()
         it = resp.iter_content(chunk_size=_CHUNK_BYTES)
         if offset and resp.status_code != 206:
@@ -161,7 +187,7 @@ def _record_split(buf: bytearray, n: int, cfg) -> int:
 
     if cfg.use_native_csv and native.available():
         return native.record_split_buffer(buf, n)
-    return native._record_split_py(bytes(buf[:n]))
+    return native._record_split_py(buf, n)
 
 
 def _parse_block(block: bytes, fields: List[str], cfg):

@@ -205,17 +205,21 @@ def parse_csv_bytes(data: bytes, has_header: bool = True) -> dict:
     return out
 
 
-def _record_split_py(block: bytes) -> int:
-    """Python fallback for record_split using C-speed primitives: try the
-    rightmost newlines and verify even quote parity via count()."""
-    if b'"' not in block:
-        return block.rfind(b"\n")
-    end = len(block)
+def _record_split_py(buf, n: Optional[int] = None) -> int:
+    """Python fallback for record_split over ``buf[:n]`` using C-speed
+    primitives with explicit bounds (no copies — the window is tens of
+    MB): try the rightmost newlines and verify even quote parity via
+    count()."""
+    if n is None:
+        n = len(buf)
+    if buf.find(b'"', 0, n) < 0:
+        return buf.rfind(b"\n", 0, n)
+    end = n
     while True:
-        cut = block.rfind(b"\n", 0, end)
+        cut = buf.rfind(b"\n", 0, end)
         if cut < 0:
             return -1
-        if block.count(b'"', 0, cut) % 2 == 0:
+        if buf.count(b'"', 0, cut) % 2 == 0:
             return cut
         end = cut
 

@@ -561,10 +561,8 @@ def _apply_regex(vals: np.ndarray, pattern: str, options: str) -> np.ndarray:
     rx = re.compile(pattern, flags)
     out = np.zeros(len(vals), dtype=bool)
     for i, v in enumerate(vals):
-        if isinstance(v, str):
+        if isinstance(v, str):          # np.str_ subclasses str
             out[i] = rx.search(v) is not None
-        elif isinstance(v, np.str_):
-            out[i] = rx.search(str(v)) is not None
     return out
 
 

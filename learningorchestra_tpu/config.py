@@ -72,7 +72,10 @@ class Settings:
     )
     #: Parser threads for streaming ingest. Row-aligned byte blocks parse
     #: concurrently (the native parser releases the GIL for the whole
-    #: call); chunks still commit in source order. 0 = os.cpu_count().
+    #: call); chunks still commit in source order. 0 = automatic:
+    #: os.cpu_count() clamped to [4, 8] (a few threads pay even on one
+    #: core by overlapping the committer's IO waits; beyond 8 the
+    #: in-order committer is the bottleneck).
     ingest_parse_threads: int = field(
         default_factory=lambda: _env("LO_TPU_INGEST_PARSE_THREADS", 0)
     )

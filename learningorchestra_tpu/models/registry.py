@@ -3,14 +3,16 @@
 The reference maps ``{"lr", "dt", "rf", "gb", "nb"}`` to pyspark.ml
 classifiers (reference model_builder.py:152-158) and returns 409 for unknown
 names (ModelBuilderRequestValidator, model_builder.py:284-292). Same five
-names here, plus the TPU-native "mlp" extension.
+names here, plus the TPU-native extensions: "mlp" (dp×tp perceptron) and
+"tx" (the dp×tp×sp transformer with ring attention, models/sequence.py).
 """
 
 from __future__ import annotations
 
 from typing import Callable, Dict
 
-from learningorchestra_tpu.models import logistic, mlp, naive_bayes, trees
+from learningorchestra_tpu.models import (
+    logistic, mlp, naive_bayes, sequence, trees)
 
 CLASSIFIERS: Dict[str, Callable] = {
     "lr": logistic.fit,
@@ -19,6 +21,7 @@ CLASSIFIERS: Dict[str, Callable] = {
     "gb": trees.fit_gb,
     "nb": naive_bayes.fit,
     "mlp": mlp.fit,
+    "tx": sequence.fit,
 }
 
 
@@ -55,4 +58,6 @@ def predictor_for(kind: str, hparams: Dict) -> Callable:
                 else naive_bayes._predict_proba)
     if kind == "mlp":
         return mlp._predict_proba
+    if kind == "tx":
+        return sequence.predictor(hparams)
     raise ValueError(f"no predictor for classifier kind {kind!r}")

@@ -1,0 +1,140 @@
+"""Sequence-classifier trainer ("tx") — the transformer as a product
+surface.
+
+Round 3 left the transformer/ring-attention tier (models/transformer.py)
+tested and benched but unreachable from the REST API (VERDICT r3 §5: "a
+capability without a user"). This adapter registers it in the classifier
+registry next to {lr,dt,rf,gb,nb,mlp}: a stored dataset whose feature
+columns are token ids trains through POST /models with
+``classificators_list: ["tx"]``, persists via orbax, and re-serves
+through /trained-models like every other family.
+
+The train step is the full 3-axis SPMD program (data × model × seq):
+batch rows shard over ``data``, attention heads / FFN hidden over
+``model`` (Megatron-style), and sequence length over ``seq`` with exact
+ring attention (parallel/ring_attention.py) — the REST surface is a thin
+adapter over exactly the machinery ``dryrun_multichip`` compiles for
+pods. No reference behavior exists to match (the reference predates
+sequence models, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.models.transformer import (
+    TxConfig, forward_reference, init_params, make_train_step, shard_params)
+from learningorchestra_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, SEQ_AXIS, MeshRuntime)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
+        num_classes: int, seed: int = 0, *, d_model: int = 64,
+        n_heads: int = 4, n_layers: int = 2, d_ff: int = 128,
+        vocab: int = 0, train_steps: int = 300, batch: int = 1024,
+        lr: float = 1e-3, causal: bool = False,
+        remat: bool = False) -> TrainedModel:
+    """Token-column design matrix → fitted transformer classifier.
+
+    The feature columns ARE the sequence: column j holds token id at
+    position j (the design matrix arrives float32; values cast back to
+    int). ``vocab=0`` infers the vocabulary from the data.
+    """
+    mesh = runtime.mesh
+    tokens_all = np.maximum(np.asarray(X, np.float32), 0.0).astype(np.int32)
+    n, T = tokens_all.shape
+    if n == 0 or T == 0:
+        raise ValueError("tx needs at least one row and one token column")
+    if not vocab:
+        vocab = int(tokens_all.max()) + 1
+    vocab = max(int(vocab), 2)
+    tokens_all = np.minimum(tokens_all, vocab - 1)
+
+    # Round every sharded dimension up to its mesh axis: T to the seq
+    # axis (pad token 0), heads/FFN to the model axis, batch to the data
+    # axis — the same program then runs on one chip or a full dp×tp×sp
+    # pod mesh.
+    S = mesh.shape[SEQ_AXIS]
+    Dax = mesh.shape[DATA_AXIS]
+    M = mesh.shape[MODEL_AXIS]
+    T_pad = _round_up(T, S)
+    if T_pad > T:
+        tokens_all = np.pad(tokens_all, ((0, 0), (0, T_pad - T)))
+    n_heads = _round_up(max(n_heads, 1), M)
+    d_ff = _round_up(max(d_ff, 1), M)
+    d_model = _round_up(max(d_model, n_heads), n_heads)
+    batch = min(_round_up(batch, Dax), _round_up(n, Dax))
+
+    cfg = TxConfig(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                   n_layers=n_layers, d_ff=d_ff, n_classes=num_classes,
+                   max_len=T_pad, causal=causal, remat=remat)
+    params = shard_params(init_params(jax.random.PRNGKey(seed), cfg),
+                          cfg, mesh)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)   # zeros_like → inherits shardings
+    train_step = make_train_step(cfg, mesh, opt)
+
+    tok_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    lab_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    y_all = np.asarray(y, np.int32)
+    rng = np.random.default_rng(seed)
+    # XLA's CPU backend can abort/deadlock when collective programs
+    # pipeline deeply (shared thunk pool — see viz/tsne.py's identical
+    # mitigation), so the simulated-mesh rig serializes steps; TPU keeps
+    # the async dispatch queue.
+    sync_steps = jax.default_backend() == "cpu"
+    for _ in range(int(train_steps)):
+        sel = rng.integers(0, n, batch)
+        bt = jax.device_put(tokens_all[sel], tok_sharding)
+        bl = jax.device_put(y_all[sel], lab_sharding)
+        params, opt_state, _loss = train_step(params, opt_state, bt, bl)
+        if sync_steps:
+            jax.block_until_ready(_loss)
+
+    # Replicate the fitted params: predict then runs the unsharded
+    # forward under plain data parallelism on any topology, and
+    # checkpointing stays a process-local numpy write (persistence.py).
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+    hp = {"vocab": vocab, "d_model": d_model, "n_heads": n_heads,
+          "n_layers": n_layers, "d_ff": d_ff, "n_classes": num_classes,
+          "max_len": T_pad, "causal": causal, "train_steps": train_steps,
+          "lr": lr}
+    return TrainedModel(kind="tx", params=params,
+                        predict_proba_fn=predictor(hp),
+                        num_classes=num_classes, hparams=hp)
+
+
+def predictor(hparams: dict):
+    """(params, X_dev) → probs for a (possibly restored) tx model."""
+    cfg = TxConfig(vocab=int(hparams["vocab"]),
+                   d_model=int(hparams["d_model"]),
+                   n_heads=int(hparams["n_heads"]),
+                   n_layers=int(hparams["n_layers"]),
+                   d_ff=int(hparams["d_ff"]),
+                   n_classes=int(hparams["n_classes"]),
+                   max_len=int(hparams["max_len"]),
+                   causal=bool(hparams.get("causal", False)))
+
+    @jax.jit
+    def proba(params, X):
+        tokens = jnp.clip(X.astype(jnp.int32), 0, cfg.vocab - 1)
+        pad = cfg.max_len - tokens.shape[1]
+        if pad < 0:
+            raise ValueError(
+                f"dataset has {tokens.shape[1]} token columns but the "
+                f"model was trained with max_len {cfg.max_len}")
+        if pad:
+            tokens = jnp.pad(tokens, ((0, 0), (0, pad)))
+        return jax.nn.softmax(
+            forward_reference(params, tokens, cfg=cfg), axis=-1)
+
+    return proba

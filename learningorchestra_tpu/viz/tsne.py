@@ -10,9 +10,13 @@ hostile to XLA, so this is a re-design around what the MXU does well:
   matmul per tile; ``lax.top_k`` keeps the 3·perplexity nearest neighbours
   (Barnes-Hut's sparse-attraction approximation); per-row bandwidths are
   bisected to the target perplexity *vectorized over all rows at once*.
-- **Symmetrized sparse attraction**: each (row, neighbour) edge contributes
-  equal-and-opposite forces via two scatter-adds, which symmetrizes
-  p_ij exactly without materializing a sparse union structure.
+- **Symmetrized sparse attraction, scatter-free**: TPU scatter-adds
+  serialize (~94 ms for the 5.5M-edge transpose term at n=60k, vs 17 ms
+  for the matching gather), so the directed kNN edge set is flipped ONCE
+  on the host into a padded incoming-edge table. Per iteration the
+  attraction is then a single dense gather + weighted reduction over
+  ``k + max_in_degree`` columns — every directed edge still acts on both
+  endpoints (exact symmetrization), but nothing scatters.
 - **Exact repulsion**: the full n² q-sum, tiled as a ``lax.scan`` over row
   blocks of the (n, 2) embedding — dense, regular, VPU-friendly flops in
   place of Barnes-Hut's quadtree (≈6 flops/pair in 2-D: ~22 GFLOP/iter at
@@ -171,10 +175,65 @@ def _repulsion(Y, valid, *, tile, use_pallas, mesh):
     )(Y, valid)
 
 
+def _edge_table(idx: np.ndarray, P: np.ndarray, n_pad: int,
+                n_valid: int) -> tuple:
+    """Flip the directed kNN edge set into one padded gather table
+    (host-side, once per embed; the structure is static across all
+    descent iterations).
+
+    Every directed edge (i → j, p) exerts w·q·(y_i − y_j) on i and the
+    opposite on j, with w = p / (2n) — the exact symmetrization the
+    scatter-add expressed. Row i's table therefore holds its k outgoing
+    neighbours followed by its incoming sources (padded with weight-0
+    self edges), so the per-iteration attraction is one gather + dense
+    reduction, no scatter.
+
+    Incoming columns cap at 2k: kNN hubs (dense-cluster centers) can draw
+    thousands of in-edges, and padding every row to the max in-degree
+    explodes the table (observed 61k × 5.7k → OOM). Edges past the cap go
+    to a COO overflow list handled by a small sorted scatter-add — exact
+    same forces, just a different summation route for the hub tail.
+
+    Returns (sym_idx (n_pad, K) int32, sym_w (n_pad, K) float32,
+    ov_src (m,) int32, ov_dst (m,) int32, ov_w (m,) float32).
+    """
+    n, k = idx.shape
+    cap = 2 * k
+    wmat = (P / (2.0 * max(n_valid, 1))).astype(np.float32)
+    # kNN should never select a padding row (they sit at distance ~1e14),
+    # but a zero weight makes that a guarantee rather than an assumption.
+    wmat[idx >= n_valid] = 0.0
+    src = np.repeat(np.arange(n, dtype=np.int64), k)
+    dst = idx.reshape(-1).astype(np.int64)
+    w = wmat.reshape(-1)
+    keep = dst < n_valid
+    src, dst, w = src[keep], dst[keep], w[keep]
+    order = np.argsort(dst, kind="stable")
+    src, dst, w = src[order], dst[order], w[order]
+    starts = np.searchsorted(dst, np.arange(n_pad))
+    rank = np.arange(len(dst)) - starts[dst]
+    counts = np.bincount(dst, minlength=n_pad) if len(dst) else \
+        np.zeros(n_pad, np.int64)
+    in_cols = int(min(counts.max(), cap)) if len(dst) else 0
+    K = k + in_cols
+    sym_idx = np.tile(np.arange(n_pad, dtype=np.int32)[:, None], (1, K)) \
+        if K else np.zeros((n_pad, 0), np.int32)
+    sym_w = np.zeros((n_pad, K), np.float32)
+    sym_idx[:n, :k] = idx
+    sym_w[:n, :k] = wmat
+    dense = rank < in_cols
+    sym_idx[dst[dense], k + rank[dense]] = src[dense].astype(np.int32)
+    sym_w[dst[dense], k + rank[dense]] = w[dense]
+    ov = ~dense
+    return (sym_idx, sym_w, src[ov].astype(np.int32),
+            dst[ov].astype(np.int32), w[ov])
+
+
 @partial(jax.jit, static_argnames=("tile", "use_pallas", "mesh"),
          donate_argnums=(0,))
-def _step(Y, vel, gains, P, idx, n_valid, exaggeration, eta, momentum, *,
-          tile, use_pallas=False, mesh=None):
+def _step(Y, vel, gains, sym_idx, sym_w, ov_src, ov_dst, ov_w, n_valid,
+          exaggeration, eta, momentum, *, tile, use_pallas=False,
+          mesh=None):
     n = Y.shape[0]
     valid = (jnp.arange(n) < n_valid).astype(jnp.float32)
 
@@ -184,19 +243,22 @@ def _step(Y, vel, gains, P, idx, n_valid, exaggeration, eta, momentum, *,
                          mesh=mesh)
     Z = jnp.maximum(Z, 1e-12)
 
-    # --- sparse symmetric attraction over kNN edges ------------------------
-    Yn = Y[idx]                                    # (n, k, 2)
+    # --- sparse symmetric attraction over the precomputed edge table -------
+    # (scatter-free: see _edge_table; padding entries are weight-0 self
+    # edges whose diff is exactly zero.)
+    Yn = Y[sym_idx]                                # (n, K, 2) one gather
     diff = Y[:, None, :] - Yn
     d2e = (diff * diff).sum(axis=-1)
     qe = 1.0 / (1.0 + d2e)
-    # symmetrized p_ij = (p_j|i + p_i|j) / 2n: every directed edge carries
-    # p/(2n) and acts on both endpoints with opposite sign.
-    w = (P * exaggeration / (2.0 * jnp.maximum(n_valid, 1))) * qe
-    w = w * valid[:, None] * valid[idx]
-    fe = w[..., None] * diff                       # (n, k, 2)
-    Fattr = fe.sum(axis=1)
-    Fattr = Fattr - jnp.zeros_like(Y).at[idx.reshape(-1)].add(
-        fe.reshape(-1, 2))
+    w = (sym_w * exaggeration) * qe
+    Fattr = (w[..., None] * diff).sum(axis=1)
+    if ov_dst.shape[0]:
+        # Hub-tail overflow edges (beyond the dense cap): dst-sorted COO,
+        # so the scatter-add takes the cheap indices_are_sorted lowering.
+        dov = Y[ov_dst] - Y[ov_src]
+        qov = 1.0 / (1.0 + (dov * dov).sum(axis=-1))
+        fov = (ov_w * exaggeration * qov)[:, None] * dov
+        Fattr = Fattr.at[ov_dst].add(fov, indices_are_sorted=True)
 
     grad = 4.0 * (Fattr - Frep / Z)
     # van der Maaten gains + momentum
@@ -252,11 +314,11 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
     d2k, idx_dev = _knn(jnp.asarray(Xp), k=k, tile=tile)
     P_cal = _calibrate(d2k[:n_valid], jnp.float32(perplexity))
     # kNN/calibration run per-process on local devices (deterministic);
-    # round-trip through host so `put` can place them replicated globally.
-    idx = put(np.asarray(idx_dev))
-    P = put(np.concatenate(
-        [np.asarray(P_cal),
-         np.zeros((len(Xp) - n_valid, k), np.float32)], axis=0))
+    # the edge table is built on host (also deterministic) so `put` can
+    # place it replicated globally.
+    table = _edge_table(
+        np.asarray(idx_dev)[:n_valid], np.asarray(P_cal), len(Xp), n_valid)
+    sym_idx, sym_w, ov_src, ov_dst, ov_w = (put(a) for a in table)
 
     rng = np.random.default_rng(seed)
     Y = put(rng.normal(scale=1e-4, size=(len(Xp), 2)).astype(np.float32))
@@ -277,8 +339,9 @@ def tsne_embed(runtime: MeshRuntime, X: np.ndarray, *,
     sync_steps = step_mesh is not None and jax.default_backend() == "cpu"
     for it in range(iters):
         early = it < exaggeration_iters
-        Y, vel, gains = _step(Y, vel, gains, P, idx, nv,
-                              exag_d[early], eta_d, mom_d[early], tile=tile,
+        Y, vel, gains = _step(Y, vel, gains, sym_idx, sym_w, ov_src,
+                              ov_dst, ov_w, nv, exag_d[early], eta_d,
+                              mom_d[early], tile=tile,
                               use_pallas=use_pallas, mesh=step_mesh)
         if sync_steps:
             jax.block_until_ready(Y)

@@ -38,7 +38,10 @@ def _acc(runtime, model, X, y):
     return float((preds == y).mean())
 
 
-@pytest.mark.parametrize("kind", sorted(CLASSIFIERS))
+# "tx" is excluded: it consumes token sequences, not continuous feature
+# vectors — casting gaussian blobs to ints is out-of-domain for it. Its
+# end-to-end coverage (REST, dp×tp×sp mesh) lives in test_sequence.py.
+@pytest.mark.parametrize("kind", sorted(set(CLASSIFIERS) - {"tx"}))
 def test_trainer_beats_floor_binary(runtime, kind):
     X, y = _blobs(n=600, classes=2)
     Xtr, ytr, Xte, yte = _split(X, y)

@@ -42,17 +42,18 @@ def test_repulsion_matches_numpy():
 
 def test_repulsion_matches_scan_path():
     """Pallas and the pure-XLA scan fallback compute the same gradient step."""
-    from learningorchestra_tpu.viz.tsne import _step
+    from learningorchestra_tpu.viz.tsne import _edge_table, _step
 
     rng = np.random.default_rng(1)
     n, tile, k = 256, 128, 8
     Y = jnp.asarray(rng.normal(scale=1e-2, size=(n, 2)), jnp.float32)
     vel = jnp.zeros_like(Y)
     gains = jnp.ones_like(Y)
-    P = jnp.asarray(rng.random((n, k)), jnp.float32)
+    P = rng.random((n, k)).astype(np.float32)
     P = P / P.sum(1, keepdims=True)
-    idx = jnp.asarray(rng.integers(0, n, (n, k)), jnp.int32)
-    args = (P, idx, jnp.float32(n), jnp.float32(12.0), jnp.float32(200.0),
+    idx = rng.integers(0, n, (n, k)).astype(np.int32)
+    table = tuple(jnp.asarray(a) for a in _edge_table(idx, P, n, n))
+    args = (*table, jnp.float32(n), jnp.float32(12.0), jnp.float32(200.0),
             jnp.float32(0.5))
 
     # _step donates Y — give each call its own buffer.

@@ -65,6 +65,81 @@ def test_tsne_separates_clusters(runtime):
     assert _silhouette_like(emb, y) > 2.0
 
 
+def _exact_joint_P(X, perplexity=30.0):
+    """Exact symmetrized t-SNE input affinities, computed independently
+    (full pairwise + per-row bisection) — the quality yardstick both
+    embeddings are scored against."""
+    n = len(X)
+    d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    target = np.log(perplexity)
+    P = np.zeros((n, n))
+    for i in range(n):
+        lo, hi, beta = 0.0, np.inf, 1.0
+        for _ in range(60):
+            w = np.exp(-d2[i] * beta)
+            s = w.sum()
+            h = np.log(s) + beta * (d2[i] * w).sum() / s
+            if h > target:
+                lo = beta
+                beta = beta * 2.0 if np.isinf(hi) else (lo + hi) / 2.0
+            else:
+                hi = beta
+                beta = (lo + hi) / 2.0
+        P[i] = w / s
+    P = (P + P.T) / (2.0 * n)
+    return np.maximum(P, 1e-12)
+
+
+def _kl_divergence(P, Y):
+    """KL(P || Q) of an embedding under exact input affinities P."""
+    d2 = ((Y[:, None, :] - Y[None, :, :]) ** 2).sum(-1)
+    q = 1.0 / (1.0 + d2)
+    np.fill_diagonal(q, 0.0)
+    Q = np.maximum(q / q.sum(), 1e-12)
+    return float((P * (np.log(P) - np.log(Q))).sum())
+
+
+def test_tsne_quality_matches_sklearn(runtime):
+    """Embedding-quality pin against the reference algorithm (the
+    reference runs sklearn.manifold.TSNE, tsne_image/tsne.py:88): on the
+    same input, our embedding's KL divergence (under independently
+    computed exact affinities) and trustworthiness must match sklearn's
+    within tolerance — cluster-separation smoke tests alone would pass
+    with a broken affinity pipeline."""
+    from sklearn.manifold import TSNE, trustworthiness
+
+    rng = np.random.default_rng(3)
+    # Structured but not trivially separable: 4 anisotropic clusters plus
+    # a connecting filament, in 20-D.
+    n_per = 450
+    centers = rng.normal(size=(4, 20)) * 5.0
+    parts = [centers[c] + rng.normal(size=(n_per, 20)) * (0.6 + 0.3 * c)
+             for c in range(4)]
+    t = rng.random(200)[:, None]
+    parts.append(centers[0] * (1 - t) + centers[1] * t
+                 + rng.normal(size=(200, 20)) * 0.3)
+    X = np.concatenate(parts).astype(np.float32)
+
+    ours = tsne_embed(runtime, X, perplexity=30, iters=500,
+                      exaggeration_iters=150)
+    sk = TSNE(n_components=2, perplexity=30, max_iter=500, init="random",
+              random_state=0, method="barnes_hut").fit_transform(X)
+
+    P = _exact_joint_P(X, perplexity=30.0)
+    kl_ours = _kl_divergence(P, ours)
+    kl_sk = _kl_divergence(P, sk)
+    # Lower KL = better fit of the affinities. Ours must be in sklearn's
+    # band (within 15% relative) — a broken affinity/descent pipeline
+    # lands far outside it.
+    assert kl_ours < kl_sk * 1.15, (kl_ours, kl_sk)
+
+    t_ours = trustworthiness(X, ours, n_neighbors=12)
+    t_sk = trustworthiness(X, sk, n_neighbors=12)
+    assert t_ours > t_sk - 0.02, (t_ours, t_sk)
+    assert t_ours > 0.85, t_ours
+
+
 def test_tsne_sharded_repulsion_matches_single_device(runtime):
     """Row-sharding the repulsion over the 8-device data axis must
     reproduce the single-device (Z, F) and step output (same math, only
