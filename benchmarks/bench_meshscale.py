@@ -1,0 +1,106 @@
+"""Simulated-mesh scaling curves: data-axis 1/2/4/8 on the CPU mesh
+(HIGGS-250k sweep + t-SNE repulsion at 8k).
+
+What this rig CAN measure: the 8-device mesh is simulated on one physical
+core (`--xla_force_host_platform_device_count`), so all shards execute
+serially and wall-clock cannot drop with P — real speedup curves need
+real chips. What the serialized simulator DOES expose is **partitioning
+overhead**: with perfect SPMD partitioning, total work is constant across
+P and T(P)/T(1) ≈ 1; redundant per-shard compute, missing shardings
+(e.g. an op silently replicated that should be split), or pathological
+collective insertion all show up as T(P)/T(1) > 1. That is the
+multi-chip performance evidence a single-host rig can actually produce —
+paired with the correctness pins (sharded == single-device numerics in
+tests/test_viz.py, test_mesh_ops.py) and the driver's dryrun_multichip.
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python benchmarks/bench_meshscale.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+from benchmarks.workload import higgs_like_xy  # noqa: E402
+
+
+def _emit(name, seconds, **extra):
+    print(json.dumps({"bench": name, "seconds": round(seconds, 3), **extra}),
+          flush=True)
+
+
+def main(n_rows=250_000, n_rep=8_192):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from learningorchestra_tpu.config import Settings
+    from learningorchestra_tpu.models import logistic, naive_bayes, trees
+    from learningorchestra_tpu.parallel.mesh import MeshRuntime, local_mesh
+    from learningorchestra_tpu.viz import tsne as tz
+
+    X, y = higgs_like_xy(n_rows, 0)
+    rng = np.random.default_rng(1)
+    Yemb = rng.normal(size=(n_rep, 2)).astype(np.float32)
+
+    fits = {"lr": logistic.fit, "nb": naive_bayes.fit, "gb": trees.fit_gb}
+    base = {}
+    for P in (1, 2, 4, 8):
+        cfg = Settings()
+        cfg.persist = False
+        rt = MeshRuntime(cfg)
+        rt._mesh = local_mesh(cfg, devices=jax.devices()[:P])
+
+        for kind, fit in fits.items():
+            # Warm up at the FULL size: jit specializes on shapes, so a
+            # subsample warmup would leave the real compile inside the
+            # timed region and poison every T(P)/T(1) ratio. Block on the
+            # fitted params both times — fit() returns while the device
+            # queue is still draining, and an unblocked timing measures
+            # dispatch, not compute.
+            jax.block_until_ready(fit(rt, X, y, 2).params)
+            t0 = time.time()
+            model = fit(rt, X, y, 2)
+            jax.block_until_ready(model.params)
+            dt = time.time() - t0
+            base.setdefault(kind, dt)
+            _emit(f"meshscale.higgs{n_rows // 1000}k.{kind}", dt,
+                  data_axis=P, t_over_t1=round(dt / base[kind], 3))
+            del model
+
+        # t-SNE repulsion (the embed's O(n²) term), sharded over P devices
+        Yd = rt.replicate(Yemb) if P > 1 else jnp.asarray(Yemb)
+        vd = rt.replicate(np.ones(n_rep, np.float32)) if P > 1 \
+            else jnp.ones(n_rep, jnp.float32)
+        mesh = rt.mesh if P > 1 else None
+        f = jax.jit(lambda Y, v: tz._repulsion(
+            Y, v, tile=1024, use_pallas=False, mesh=mesh))
+        Z, F = f(Yd, vd)
+        jax.block_until_ready(F)                    # compile
+        t0 = time.time()
+        reps = 5
+        for _ in range(reps):
+            Z, F = f(Yd, vd)
+            jax.block_until_ready(F)
+        dt = (time.time() - t0) / reps
+        base.setdefault("rep", dt)
+        _emit(f"meshscale.tsne_repulsion_{n_rep // 1024}k", dt, data_axis=P,
+              t_over_t1=round(dt / base["rep"], 3))
+
+
+if __name__ == "__main__":
+    main()

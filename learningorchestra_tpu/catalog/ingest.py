@@ -75,7 +75,10 @@ def _content_range_total(value) -> Optional[int]:
 
 def _skip_bytes(chunks: Iterator[bytes], n: int) -> Iterator[bytes]:
     """Drop the first ``n`` bytes of a chunk iterator (resume fallback for
-    servers that ignore Range requests)."""
+    servers that ignore Range requests). The source must actually HAVE
+    ``n`` bytes: a stream that ends earlier is shorter than the committed
+    offset — the content changed, and silently yielding nothing would
+    mark a truncated dataset finished."""
     for chunk in chunks:
         if n >= len(chunk):
             n -= len(chunk)
@@ -84,6 +87,10 @@ def _skip_bytes(chunks: Iterator[bytes], n: int) -> Iterator[bytes]:
             chunk = chunk[n:]
             n = 0
         yield chunk
+    if n > 0:
+        raise SourceChanged(
+            f"source ended {n} bytes before the committed resume offset; "
+            "it must have changed since the interrupted ingest")
 
 
 def _source_identity(url: str, timeout: float) -> dict:

@@ -130,6 +130,18 @@ class Settings:
     allow_exec_preprocessing: bool = field(
         default_factory=lambda: _env("LO_TPU_ALLOW_EXEC", False, bool)
     )
+    #: Resource jail for exec preprocessing (ops/exec_jail.py): wall-clock
+    #: timeout, CPU seconds, and address-space cap for the child process.
+    #: 0 disables the respective limit.
+    exec_timeout_seconds: float = field(
+        default_factory=lambda: _env("LO_TPU_EXEC_TIMEOUT_S", 300.0)
+    )
+    exec_cpu_seconds: int = field(
+        default_factory=lambda: _env("LO_TPU_EXEC_CPU_S", 300)
+    )
+    exec_memory_mb: int = field(
+        default_factory=lambda: _env("LO_TPU_EXEC_MEM_MB", 4096)
+    )
     #: Checkpoint fitted models (orbax) into store_root/_models so they can
     #: be listed and re-used for prediction. The reference discards models
     #: after use (model_builder.py:227-248) — this is the §5 upgrade.

@@ -93,7 +93,7 @@ class ModelBuilder:
                     "exec preprocessing is disabled; enable "
                     "LO_TPU_ALLOW_EXEC or use declarative steps")
             X_train, y_train, X_test, y_test = preprocess.exec_preprocess(
-                preprocessor_code, train_ds, test_ds, label)
+                preprocessor_code, train_ds, test_ds, label, cfg=self.cfg)
             feature_fields = [f"f{i}" for i in range(X_train.shape[1])]
         else:
             # Memoized per dataset-snapshot: repeat builds on the same data

@@ -168,27 +168,56 @@ def design_matrix(ds: Dataset, label: str,
 
 
 def exec_preprocess(code: str, train_ds: Dataset, test_ds: Dataset,
-                    label: str):
-    """Sandboxed-by-flag exec path (reference model_builder.py:145-150)."""
-    import pandas as pd
+                    label: str, cfg=None):
+    """Flag-gated exec path (reference model_builder.py:145-150), run in a
+    resource-jailed child process.
 
-    scope: Dict[str, Any] = {
-        "training_df": pd.DataFrame(
-            {f: train_ds.columns[f] for f in train_ds.metadata.fields}),
-        "testing_df": pd.DataFrame(
-            {f: test_ds.columns[f] for f in test_ds.metadata.fields}),
-        "np": np, "pd": pd, "label": label,
+    The reference exec()s user code inside the service driver; here the
+    code runs in a separate interpreter under POSIX rlimits (CPU seconds,
+    address space, no cores — ops/exec_jail.py) with a wall-clock
+    timeout, so an infinite loop, memory bomb, or segfaulting extension
+    fails that one job instead of the server. A resource jail, not a
+    security boundary — the gate stays ``allow_exec_preprocessing``.
+    """
+    import pickle
+    import subprocess
+    import sys
+
+    from learningorchestra_tpu.config import settings as global_settings
+
+    cfg = cfg or global_settings
+    req = {
+        "code": code,
+        "train_cols": {f: train_ds.columns[f]
+                       for f in train_ds.metadata.fields},
+        "test_cols": {f: test_ds.columns[f]
+                      for f in test_ds.metadata.fields},
+        "label": label,
+        "cpu_s": int(cfg.exec_cpu_seconds),
+        "mem_mb": int(cfg.exec_memory_mb),
     }
-    exec(code, scope)  # noqa: S102 — gated by settings.allow_exec_preprocessing
     try:
-        X_train = np.asarray(scope["features_training"], np.float32)
-        y_train = np.asarray(scope["labels_training"], np.int32)
-        X_test = np.asarray(scope["features_testing"], np.float32)
-    except KeyError as exc:
+        proc = subprocess.run(
+            [sys.executable, "-m", "learningorchestra_tpu.ops.exec_jail"],
+            input=pickle.dumps(req, protocol=pickle.HIGHEST_PROTOCOL),
+            capture_output=True,
+            timeout=cfg.exec_timeout_seconds or None)
+    except subprocess.TimeoutExpired:
         raise PreprocessError(
-            f"preprocessor code must define {exc} (plus features_training, "
-            "labels_training, features_testing)") from exc
-    y_test = scope.get("labels_testing")
+            f"preprocessor code exceeded the {cfg.exec_timeout_seconds}s "
+            "wall-clock limit") from None
+    if proc.returncode != 0 or not proc.stdout:
+        tail = proc.stderr.decode("utf-8", "replace").strip()[-500:]
+        raise PreprocessError(
+            "preprocessor process died "
+            f"(exit {proc.returncode}): {tail or 'no output'}")
+    out = pickle.loads(proc.stdout)
+    if "error" in out:
+        raise PreprocessError(out["error"])
+    X_train = np.asarray(out["X_train"], np.float32)
+    y_train = np.asarray(out["y_train"], np.int32)
+    X_test = np.asarray(out["X_test"], np.float32)
+    y_test = out["y_test"]
     if y_test is not None:
         y_test = np.asarray(y_test, np.int32)
     return X_train, y_train, X_test, y_test

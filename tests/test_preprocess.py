@@ -74,3 +74,73 @@ def test_fillna_all_nan_column_fills_zero():
     cols, _ = apply_steps({"void": np.full(8, np.nan)},
                           [{"op": "fillna", "strategy": "mean"}])
     assert (cols["void"] == 0.0).all()
+
+
+# -- exec resource jail ------------------------------------------------------
+
+def _tiny_ds(name, n=20, seed=0):
+    from learningorchestra_tpu.catalog.dataset import Dataset, Metadata
+
+    rng = np.random.default_rng(seed)
+    cols = {"a": rng.normal(size=n).astype(np.float32),
+            "y": (np.arange(n) % 2).astype(np.int64)}
+    return Dataset(Metadata(name, fields=list(cols)), columns=cols)
+
+
+def _jail_cfg(**kw):
+    from learningorchestra_tpu.config import Settings
+
+    cfg = Settings()
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_exec_jail_runs_good_code():
+    from learningorchestra_tpu.ops.preprocess import exec_preprocess
+
+    code = """
+features_training = training_df[["a"]].to_numpy()
+labels_training = training_df["y"].to_numpy()
+features_testing = testing_df[["a"]].to_numpy()
+labels_testing = testing_df["y"].to_numpy()
+"""
+    X, y, Xt, yt = exec_preprocess(code, _tiny_ds("tr"), _tiny_ds("te", 10),
+                                   "y", cfg=_jail_cfg())
+    assert X.shape == (20, 1) and Xt.shape == (10, 1)
+    assert set(np.unique(y)) == {0, 1} and yt is not None
+
+
+def test_exec_jail_kills_infinite_loop():
+    """An infinite loop in user code fails THAT job cleanly — the
+    reference's bare exec() would wedge the worker forever."""
+    from learningorchestra_tpu.ops.preprocess import (
+        PreprocessError, exec_preprocess)
+
+    with pytest.raises(PreprocessError, match="limit|died"):
+        exec_preprocess("while True: pass", _tiny_ds("tr"), _tiny_ds("te"),
+                        "y", cfg=_jail_cfg(exec_timeout_seconds=3.0,
+                                           exec_cpu_seconds=2))
+
+
+def test_exec_jail_survives_hard_crash():
+    """User code killing its own process (the stand-in for a segfaulting
+    extension) surfaces as a job failure, not a dead server."""
+    from learningorchestra_tpu.ops.preprocess import (
+        PreprocessError, exec_preprocess)
+
+    with pytest.raises(PreprocessError, match="died"):
+        exec_preprocess("import os; os._exit(42)", _tiny_ds("tr"),
+                        _tiny_ds("te"), "y", cfg=_jail_cfg())
+
+
+def test_exec_jail_reports_user_exception():
+    from learningorchestra_tpu.ops.preprocess import (
+        PreprocessError, exec_preprocess)
+
+    with pytest.raises(PreprocessError, match="ZeroDivisionError"):
+        exec_preprocess("x = 1 / 0", _tiny_ds("tr"), _tiny_ds("te"), "y",
+                        cfg=_jail_cfg())
+    with pytest.raises(PreprocessError, match="must define"):
+        exec_preprocess("pass", _tiny_ds("tr"), _tiny_ds("te"), "y",
+                        cfg=_jail_cfg())
