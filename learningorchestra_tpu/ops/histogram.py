@@ -102,6 +102,17 @@ def field_counts(runtime: MeshRuntime, col: np.ndarray) -> Dict:
         lo, hi = int(col.min()), int(col.max())
         num_bins = hi - lo + 1
         if 0 < num_bins <= MAX_DEVICE_BINS:
+            n_dev = int(np.prod(list(runtime.mesh.shape.values())))
+            if n_dev == 1:
+                # One device: the mesh path buys nothing and its
+                # host↔device round trip dominates on a tunneled chip
+                # (measured 146 ms vs 0.6 ms per 262k-row chunk). Same
+                # exact counts; the decision depends only on the global
+                # mesh, so it is identical on every pod process.
+                counts = np.bincount((col - lo).astype(np.int64),
+                                     minlength=num_bins)
+                return {int(lo + i): int(c)
+                        for i, c in enumerate(counts) if c}
             codes = (col - lo).astype(np.int32)
             sharded, n = runtime.shard_rows(codes)
             counts = np.asarray(_mesh_bincount(
