@@ -59,6 +59,22 @@ def test_field_counts_negative_ints(runtime):
     assert field_counts(runtime, col) == {-3: 2, 0: 1, 2: 3}
 
 
+def test_field_counts_single_device_matches_mesh(runtime):
+    """The single-device host bincount shortcut (no device round trip)
+    must produce exactly the mesh path's counts."""
+    import jax
+
+    from learningorchestra_tpu.config import Settings
+    from learningorchestra_tpu.parallel.mesh import MeshRuntime, local_mesh
+
+    one = MeshRuntime(Settings())
+    one._mesh = local_mesh(one.cfg, devices=jax.devices()[:1])
+    assert int(np.prod(list(one.mesh.shape.values()))) == 1
+    rng = np.random.default_rng(3)
+    col = rng.integers(-7, 40, size=2111).astype(np.int64)
+    assert field_counts(one, col) == field_counts(runtime, col)
+
+
 def test_field_counts_strings_and_floats(runtime):
     col = np.array(["a", "b", "a", None], dtype=object)
     assert field_counts(runtime, col) == {"a": 2, "b": 1, None: 1}
