@@ -55,6 +55,13 @@ class InvalidCsvUrl(ValueError):
 _CHUNK_BYTES = 1 << 20          # 1 MiB download chunks
 _QUEUE_DEPTH = 64               # bounded: ~64 MiB in flight max
 
+#: Hard ceiling on one row-aligned block. The native tokenizer stores cell
+#: spans as uint32 with the high bit reserved (csv_parser.cpp kArenaBit)
+#: and int32 Arrow offsets, so blocks must stay well under 2 GiB. Without
+#: a cap, one stray unmatched quote flips every later newline's parity odd
+#: and the widening loop would accumulate the whole remaining stream.
+_MAX_BLOCK_BYTES = 1 << 30
+
 
 def _sniff_header(first_chunk: bytes, url: str) -> None:
     """Reject obviously-non-CSV payloads, as the reference does by checking
@@ -195,6 +202,25 @@ def _record_split(buf: bytearray, n: int, cfg) -> int:
     if cfg.use_native_csv and native.available():
         return native.record_split_buffer(buf, n)
     return native._record_split_py(buf, n)
+
+
+def _first_record_end(buf, start: int = 0, quotes: int = 0):
+    """Scan ``buf[start:]`` for the first newline at even cumulative quote
+    parity — the end of the first complete CSV record. Returns
+    ``(nl, scanned_to, quotes)``; ``nl`` is -1 when no complete record is
+    buffered yet, in which case the caller passes ``scanned_to``/``quotes``
+    back in after appending more bytes, keeping the overall scan linear in
+    the buffer (not quadratic across reads)."""
+    pos = start
+    while True:
+        nl = buf.find(b"\n", pos)
+        if nl < 0:
+            quotes += buf.count(b'"', pos)
+            return -1, len(buf), quotes
+        quotes += buf.count(b'"', pos, nl + 1)
+        pos = nl + 1
+        if quotes % 2 == 0:
+            return nl, pos, quotes
 
 
 def _parse_block(block: bytes, fields: List[str], cfg):
@@ -384,14 +410,31 @@ def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
         buf.extend(item)
         return True
 
-    # -- header (fresh ingest only): first line names the columns ---------
+    # -- header (fresh ingest only): first record names the columns -------
+    # Quote-parity aware: a quoted header field may legally contain an
+    # embedded newline, so cut at the first newline with EVEN quote parity,
+    # not the first b"\n" (which would split the header mid-record and
+    # misalign every later block).
     if fields is None:
-        while b"\n" not in buf and read_more():
-            pass
-        nl = buf.find(b"\n")
+        nl, scanned, hq = _first_record_end(buf)
+        while nl < 0 and read_more():
+            if len(buf) > _MAX_BLOCK_BYTES:
+                raise ValueError(
+                    "no complete header record within "
+                    f"{_MAX_BLOCK_BYTES} bytes — unbalanced quote in the "
+                    "CSV header?")
+            nl, scanned, hq = _first_record_end(buf, scanned, hq)
         if nl < 0:
             if not buf.strip():
                 return              # empty source, zero-row dataset
+            if b"\n" in buf:
+                # EOF with newlines present but every one at odd quote
+                # parity: the header's quoting is unbalanced. Raising
+                # beats silently swallowing the whole file as "the
+                # header" and finishing a garbled zero-row dataset.
+                raise ValueError(
+                    "CSV ended inside a quoted header field — unbalanced "
+                    "quote in the CSV header?")
             nl = len(buf) - 1       # header-only file without newline
         header = bytes(buf[:nl + 1])
         del buf[:nl + 1]
@@ -424,7 +467,18 @@ def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
                     else:
                         break
                 else:
-                    target *= 2  # giant quoted record: widen the window
+                    # Giant quoted record: widen the window — but only up
+                    # to the hard cap the native parser's 31-bit spans
+                    # require. Past it, the only explanation is a corrupt
+                    # stream (unmatched quote), and failing the job beats
+                    # buffering the remaining terabyte then corrupting
+                    # spans.
+                    if target >= _MAX_BLOCK_BYTES:
+                        raise ValueError(
+                            "no record boundary within "
+                            f"{_MAX_BLOCK_BYTES} bytes near source offset "
+                            f"{abs_off} — unbalanced quote in the CSV?")
+                    target = min(target * 2, _MAX_BLOCK_BYTES)
                     continue
         block = bytes(buf[:cut + 1])
         del buf[:cut + 1]

@@ -70,11 +70,43 @@ def column_value_counts(col: np.ndarray) -> Dict[Any, int]:
         # astype(str) domain for the rare non-string object cell.
         import pandas as pd
 
-        vc = pd.Series(col, dtype=object).value_counts(dropna=True)
-        out: Dict[Any, int] = {
-            (k if isinstance(k, str) else str(k)): int(c)
-            for k, c in vc.items()}
-        n_null = len(col) - int(vc.sum())
+        try:
+            vc = pd.Series(col, dtype=object).value_counts(dropna=True)
+        except TypeError:
+            # Unhashable cells (e.g. the dict-valued 'counts' column that
+            # create_histogram writes): per-cell walk with the SAME key
+            # domain as the hashable path below — scalars keep native
+            # type, everything else stringifies, NaN/None bucket under
+            # None — so which branch a chunk takes never changes its keys.
+            out = {}
+            n_null = 0
+            for v in col:
+                if v is None or (isinstance(v, (float, np.floating))
+                                 and v != v):
+                    n_null += 1
+                    continue
+                if isinstance(v, np.generic):
+                    v = v.item()
+                if not isinstance(v, (str, int, float)):
+                    v = str(v)
+                out[v] = out.get(v, 0) + 1
+        else:
+            # Key domain must match the histogram device path, which
+            # returns NATIVE int keys (ops/histogram.py field_counts): a
+            # column whose chunks flip between int64 and object dtype
+            # (per-block type inference on mixed data) must not split one
+            # value's count across an int bucket and a str bucket. So
+            # numeric keys stay native; only non-scalar cells stringify —
+            # accumulated, not overwritten, since distinct unhashables can
+            # stringify alike.
+            out = {}
+            for k, c in vc.items():
+                if isinstance(k, np.generic):
+                    k = k.item()
+                if not isinstance(k, (str, int, float)):
+                    k = str(k)
+                out[k] = out.get(k, 0) + int(c)
+            n_null = len(col) - int(vc.sum())
         if n_null:
             out[None] = n_null
         return out

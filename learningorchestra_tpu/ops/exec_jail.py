@@ -13,13 +13,19 @@ server's uid and filesystem. The gate against untrusted code remains
 ``settings.allow_exec_preprocessing`` (off by default; the declarative
 step API is the default path).
 
-Protocol: pickled request dict on stdin → pickled response dict on
-stdout. Never imported by the server; invoked as
-``python -m learningorchestra_tpu.ops.exec_jail``.
+Protocol: pickled request dict on stdin (the parent is trusted) → npz
+archive on stdout, which the parent decodes with ``allow_pickle=False``.
+The reply is deliberately NOT pickle: user code sharing the process can
+always find the reply pipe (scan /proc/self/fd), so the parent must never
+run a deserializer that executes. With npz, forged reply bytes yield at
+worst wrong arrays — a power user code already has, since it defines
+``features_training`` itself — or a clean decode failure. Never imported
+by the server; invoked as ``python -m learningorchestra_tpu.ops.exec_jail``.
 """
 
 from __future__ import annotations
 
+import os
 import pickle
 import resource
 import sys
@@ -44,10 +50,18 @@ def main() -> int:
     import numpy as np
     import pandas as pd
 
-    # The response channel is the REAL stdout; user code sees stderr as
-    # its stdout, so a stray print() cannot corrupt the pickled reply.
-    response = sys.stdout.buffer
+    # Move the reply pipe OFF fd 1 before user code runs: dup it to a
+    # private fd, then point fd 1 at stderr, so a stray print() or naive
+    # os.write(1, ...) lands on stderr instead of corrupting the reply.
+    # This is hygiene, not isolation — code in this process can still find
+    # the dup'd fd — which is why the reply encoding (npz, decoded with
+    # allow_pickle=False) is what actually keeps forged bytes harmless.
+    reply_fd = os.dup(sys.stdout.fileno())
+    os.set_inheritable(reply_fd, False)
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    response = os.fdopen(reply_fd, "wb")
     sys.stdout = sys.stderr
+    sys.__stdout__ = sys.stderr
 
     scope = {
         "training_df": pd.DataFrame(req["train_cols"]),
@@ -82,7 +96,15 @@ def main() -> int:
                                  if y_test is not None else None)
             except BaseException as exc:  # noqa: BLE001
                 out = {"error": f"{type(exc).__name__}: {exc}"}
-    pickle.dump(out, response, protocol=pickle.HIGHEST_PROTOCOL)
+    arrays = {}
+    if "error" in out:
+        arrays["error"] = np.array(str(out["error"]))   # dtype <U, no pickle
+    else:
+        for key in ("X_train", "y_train", "X_test"):
+            arrays[key] = out[key]
+        if out.get("y_test") is not None:
+            arrays["y_test"] = out["y_test"]
+    np.savez(response, **arrays)
     response.flush()
     return 0
 

@@ -196,11 +196,22 @@ def exec_preprocess(code: str, train_ds: Dataset, test_ds: Dataset,
         "cpu_s": int(cfg.exec_cpu_seconds),
         "mem_mb": int(cfg.exec_memory_mb),
     }
+    # The child is a FRESH interpreter that must import this same package.
+    # When the parent runs from a source checkout (sys.path manipulation
+    # rather than pip install), the child wouldn't find it — prepend the
+    # package's parent directory so the jail always loads the code the
+    # server is running.
+    import os
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "learningorchestra_tpu.ops.exec_jail"],
             input=pickle.dumps(req, protocol=pickle.HIGHEST_PROTOCOL),
-            capture_output=True,
+            capture_output=True, env=env,
             timeout=cfg.exec_timeout_seconds or None)
     except subprocess.TimeoutExpired:
         raise PreprocessError(
@@ -211,13 +222,30 @@ def exec_preprocess(code: str, train_ds: Dataset, test_ds: Dataset,
         raise PreprocessError(
             "preprocessor process died "
             f"(exit {proc.returncode}): {tail or 'no output'}")
-    out = pickle.loads(proc.stdout)
+    # The reply is npz, NEVER pickle: the child shares its process with
+    # user code, which can always find the reply pipe, so nothing the
+    # parent runs on these bytes may execute. allow_pickle=False makes a
+    # forged reply at worst wrong arrays (user code defines the arrays
+    # anyway) or a clean decode failure.
+    import io
+
+    # NpzFile decodes LAZILY (np.load only parses the zip directory), so
+    # every per-entry access — including a forged pickled-object entry or
+    # a missing key — must happen inside this try for the fail-clean
+    # contract to hold.
+    try:
+        with np.load(io.BytesIO(proc.stdout), allow_pickle=False) as npz:
+            out = {k: npz[k] for k in npz.files}
+        if "error" not in out:
+            X_train = np.asarray(out["X_train"], np.float32)
+            y_train = np.asarray(out["y_train"], np.int32)
+            X_test = np.asarray(out["X_test"], np.float32)
+            y_test = (np.asarray(out["y_test"], np.int32)
+                      if "y_test" in out else None)
+    except Exception:  # noqa: BLE001 — any corrupt reply is a job failure
+        raise PreprocessError(
+            "preprocessor reply was corrupt (user code wrote to the "
+            "reply channel?)") from None
     if "error" in out:
-        raise PreprocessError(out["error"])
-    X_train = np.asarray(out["X_train"], np.float32)
-    y_train = np.asarray(out["y_train"], np.int32)
-    X_test = np.asarray(out["X_test"], np.float32)
-    y_test = out["y_test"]
-    if y_test is not None:
-        y_test = np.asarray(y_test, np.int32)
+        raise PreprocessError(str(out["error"][()]))
     return X_train, y_train, X_test, y_test

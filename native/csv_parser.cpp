@@ -305,6 +305,11 @@ extern "C" {
 // 0 = infer the width from the first record (whole-buffer callers).
 void* lo_csv_parse(const char* data, size_t len, int has_header,
                    int ncols_hint) {
+  // Spans are 31-bit (kArenaBit reserves the top bit) and Arrow string
+  // offsets are int32: a buffer the encoding cannot address must be
+  // refused here, not silently corrupted. The Python splitter caps blocks
+  // at 1 GiB; this enforces the contract against every caller.
+  if (len > static_cast<size_t>(0x7FFFFFFF)) return nullptr;
   auto* t = new Table();
   t->buf.assign(data, len);
   const std::string& buf = t->buf;

@@ -202,6 +202,42 @@ def test_value_counts(store):
     assert store.value_counts("d", "sex") == {"m": 3, "f": 1}
 
 
+def test_value_counts_unhashable_and_stringify_collisions(store):
+    """ADVICE r4: unhashable cells (dict-valued 'counts' columns that
+    create_histogram appends) must not raise, and distinct values that
+    stringify alike must never overwrite each other's counts."""
+    cols = {"c": np.array([{"a": 1}, {"a": 1}, {"b": 2}], dtype=object)}
+    store.create("u", columns=cols, finished=True)
+    out = store.value_counts("u", "c")
+    assert out == {"{'a': 1}": 2, "{'b': 2}": 1}
+
+    # Scalar keys keep their native type (1.5 and "1.5" are DISTINCT
+    # values and stay distinct buckets) — so no count is ever lost and
+    # the key domain matches the histogram device path's int keys.
+    cols = {"v": np.array([1.5, "1.5", 1.5, "x"], dtype=object)}
+    store.create("v", columns=cols, finished=True)
+    assert store.value_counts("v", "v") == {1.5: 2, "1.5": 1, "x": 1}
+
+
+def test_value_counts_object_ints_match_device_key_domain(store):
+    """A mixed column whose chunks flip between int64 and object dtype
+    must not split one value's count across int and str buckets: object
+    cells holding ints produce native int keys, mergeable with the
+    histogram device path's {int: count} output."""
+    cols = {"m": np.array([5, 5, "abc", 7], dtype=object)}
+    store.create("m", columns=cols, finished=True)
+    out = store.value_counts("m", "m")
+    assert out == {5: 2, "abc": 1, 7: 1}
+    assert all(isinstance(k, (int, str)) for k in out)
+
+    # The unhashable FALLBACK must use the identical key domain: ints
+    # stay ints, np.float32 NaN buckets under None (not a "nan" string).
+    cols = {"f": np.array([5, 5, {"a": 1}, np.float32("nan")],
+                          dtype=object)}
+    store.create("f", columns=cols, finished=True)
+    assert store.value_counts("f", "f") == {5: 2, "{'a': 1}": 1, None: 1}
+
+
 def test_persistence_roundtrip(cfg):
     cfg.persist = True
     store = DatasetStore(cfg)

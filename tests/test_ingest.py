@@ -46,6 +46,48 @@ def test_ingest_chunked_many_rows(store, cfg, tmp_path):
     assert ds.column("y")[n - 1] == (n - 1) * 2
 
 
+def test_header_with_quoted_embedded_newline(store, cfg, tmp_path):
+    """ADVICE r4: a quoted header field may legally contain a newline; the
+    header cut must be quote-parity aware, not first-b'\\n'."""
+    p = tmp_path / "h.csv"
+    p.write_text('"first\ncol",b\n1,2\n3,4\n')
+    store.create("h", url=str(p))
+    ingest_csv_url(store, "h", str(p), cfg)
+    ds = store.get("h")
+    assert ds.metadata.fields == ["first\ncol", "b"]
+    assert ds.num_rows == 2
+    assert list(ds.column("b")) == [2, 4]
+
+
+def test_unmatched_quote_fails_instead_of_buffering_stream(
+        store, cfg, tmp_path, monkeypatch):
+    """ADVICE r4 (medium): one stray unmatched quote must produce a clear
+    parse error, not widen the block window over the whole remaining
+    stream (which would overflow the native parser's 31-bit spans)."""
+    from learningorchestra_tpu.catalog import ingest as ing
+
+    monkeypatch.setattr(ing, "_MAX_BLOCK_BYTES", 1 << 16)
+    cfg.ingest_chunk_rows = 10
+    rows = ["a,b"] + [f'{i},"broken' if i == 5 else f"{i},ok"
+                      for i in range(20_000)]
+    p = tmp_path / "q.csv"
+    p.write_text("\n".join(rows) + "\n")
+    store.create("q", url=str(p))
+    with pytest.raises(ValueError, match="unbalanced quote"):
+        ingest_csv_url(store, "q", str(p), cfg)
+
+
+def test_unbalanced_header_quote_small_file_raises(store, cfg, tmp_path):
+    """A small file whose header has an unbalanced quote must raise, not
+    silently swallow the whole file as 'the header' and finish a garbled
+    zero-row dataset."""
+    p = tmp_path / "bad.csv"
+    p.write_text('a,"b\n1,2\n3,4\n')
+    store.create("bad", url=str(p))
+    with pytest.raises(ValueError, match="unbalanced quote"):
+        ingest_csv_url(store, "bad", str(p), cfg)
+
+
 def test_sniff_rejects_html_and_json():
     with pytest.raises(InvalidCsvUrl):
         _sniff_header(b"<!DOCTYPE html><html>", "u")

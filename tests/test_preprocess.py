@@ -134,6 +134,51 @@ def test_exec_jail_survives_hard_crash():
                         _tiny_ds("te"), "y", cfg=_jail_cfg())
 
 
+def test_exec_jail_stray_output_cannot_corrupt_reply():
+    """ADVICE r4: fd 1 and sys.__stdout__ point at stderr inside the jail,
+    so prints and naive fd-1 writes never reach the reply pipe."""
+    from learningorchestra_tpu.ops.preprocess import exec_preprocess
+
+    code = """
+import os, sys, pickle
+os.write(1, pickle.dumps({"error": "forged-via-fd1"}))
+sys.__stdout__.write("forged-via-dunder")
+sys.__stdout__.flush()
+print("forged-via-print")
+features_training = training_df[["a"]].to_numpy()
+labels_training = training_df["y"].to_numpy()
+features_testing = testing_df[["a"]].to_numpy()
+"""
+    X, y, Xt, yt = exec_preprocess(code, _tiny_ds("tr"), _tiny_ds("te", 10),
+                                   "y", cfg=_jail_cfg())
+    assert X.shape == (20, 1) and Xt.shape == (10, 1)
+
+
+def test_exec_jail_forged_reply_fails_clean_never_deserializes():
+    """User code CAN find the dup'd reply fd (same process); what it must
+    never achieve is making the server run a deserializer that executes.
+    Spraying a pickle at every open fd produces a clean PreprocessError —
+    the parent decodes npz with allow_pickle=False, never pickle."""
+    from learningorchestra_tpu.ops.preprocess import (
+        PreprocessError, exec_preprocess)
+
+    code = """
+import os, pickle
+payload = pickle.dumps({"error": "forged"})
+for fd in range(3, 64):
+    try:
+        os.write(fd, payload)
+    except OSError:
+        pass
+features_training = training_df[["a"]].to_numpy()
+labels_training = training_df["y"].to_numpy()
+features_testing = testing_df[["a"]].to_numpy()
+"""
+    with pytest.raises(PreprocessError, match="corrupt"):
+        exec_preprocess(code, _tiny_ds("tr"), _tiny_ds("te", 10), "y",
+                        cfg=_jail_cfg())
+
+
 def test_exec_jail_reports_user_exception():
     from learningorchestra_tpu.ops.preprocess import (
         PreprocessError, exec_preprocess)
