@@ -760,51 +760,119 @@ class Dataset:
                 chunks = chunks[:max_chunks]
             self._active_readers += 1
         try:
-            want = fields
-            target: Dict[str, np.dtype] = {}
-            seen: Dict[str, set] = {}
+            coerce = self._make_coercer(chunks, fields)
             for c in chunks:
-                for f, dt in c.dtypes.items():
-                    if want is None or f in want:
-                        seen.setdefault(f, set()).add(dt)
-            for f, dts in seen.items():
-                if len(dts) > 1:
-                    target[f] = (np.dtype(object)
-                                 if any(dt == object for dt in dts)
-                                 else np.result_type(*dts))
-            # Numeric→object coercion stringifies only when the object
-            # chunks hold strings (same rule as _concat); object chunks
-            # already on disk are strings by construction.
-            nonstringy = set()
-            if any(t == object for t in target.values()):
-                for c in chunks:
-                    ccols = c.cols
-                    if ccols is None:
-                        continue
-                    for f, a in ccols.items():
-                        if (target.get(f) == object and a.dtype == object
-                                and not is_stringy(a)):
-                            nonstringy.add(f)
-
-            def _coerce(f: str, a: np.ndarray) -> np.ndarray:
-                t = target.get(f)
-                if t is None or a.dtype == t:
-                    return a
-                if t != object:
-                    return a.astype(t)
-                return (a.astype(object) if f in nonstringy
-                        else stringify_numeric(a))
-
-            for c in chunks:
-                cols = c.materialize(want)
-                if target:
-                    cols = {f: _coerce(f, a) for f, a in cols.items()}
-                yield cols
+                cols = c.materialize(fields)
+                yield {f: coerce(f, a) for f, a in cols.items()}
         finally:
             with self._data_lock:
                 self._active_readers -= 1
                 if self._pending_gc and not self._active_readers:
                     self._gc_locked()
+
+    @staticmethod
+    def _make_coercer(chunks, want):
+        """Per-field dtype coercer unifying a chunk snapshot's dtypes to
+        what full consolidation would produce (``iter_chunks``'s contract;
+        shared with ``read_rows``)."""
+        target: Dict[str, np.dtype] = {}
+        seen: Dict[str, set] = {}
+        for c in chunks:
+            for f, dt in c.dtypes.items():
+                if want is None or f in want:
+                    seen.setdefault(f, set()).add(dt)
+        for f, dts in seen.items():
+            if len(dts) > 1:
+                target[f] = (np.dtype(object)
+                             if any(dt == object for dt in dts)
+                             else np.result_type(*dts))
+        # Numeric→object coercion stringifies only when the object
+        # chunks hold strings (same rule as _concat); object chunks
+        # already on disk are strings by construction.
+        nonstringy = set()
+        if any(t == object for t in target.values()):
+            for c in chunks:
+                ccols = c.cols
+                if ccols is None:
+                    continue
+                for f, a in ccols.items():
+                    if (target.get(f) == object and a.dtype == object
+                            and not is_stringy(a)):
+                        nonstringy.add(f)
+
+        def _coerce(f: str, a: np.ndarray) -> np.ndarray:
+            t = target.get(f)
+            if t is None or a.dtype == t:
+                return a
+            if t != object:
+                return a.astype(t)
+            return (a.astype(object) if f in nonstringy
+                    else stringify_numeric(a))
+
+        return _coerce
+
+    def read_rows(self, fields: Optional[List[str]] = None,
+                  start: int = 0, stop: Optional[int] = None,
+                  max_chunks: Optional[int] = None) -> Columns:
+        """Materialize ONLY the chunks overlapping rows ``[start, stop)``
+        and return that row range — O(overlapping chunks) host memory, not
+        O(dataset). This is the shard-local read the pod data path builds
+        device shards from (each process reads just its own row ranges
+        instead of consolidating the full dataset; contrast the
+        reference's executors, which likewise hold only their partitions,
+        model_builder.py:200). Dtypes are unified exactly as
+        ``iter_chunks``/consolidation would, so a range read never sees
+        chunk-local dtype drift."""
+        with self._data_lock:
+            chunks = list(self._chunks)
+            if max_chunks is not None:
+                chunks = chunks[:max_chunks]
+            self._active_readers += 1
+        try:
+            coerce = self._make_coercer(chunks, fields)
+            total = sum(c.n_rows for c in chunks)
+            stop = total if stop is None else min(stop, total)
+            start = max(0, min(start, stop))
+            parts: List[Columns] = []
+            off = 0
+            for c in chunks:
+                end = off + c.n_rows
+                if end > start and off < stop:
+                    cols = c.materialize(fields)
+                    lo, hi = max(start - off, 0), min(stop - off, c.n_rows)
+                    # Slice BEFORE coercing: the coercer is elementwise,
+                    # and coercing a whole 256k-row chunk to return a
+                    # 10-row page would make page reads O(chunk).
+                    parts.append({f: coerce(f, a[lo:hi])
+                                  for f, a in cols.items()})
+                off = end
+                if off >= stop:
+                    break
+            if not parts:
+                flds = (fields if fields is not None
+                        else list(self.metadata.fields))
+                dts = {f: dt for c in chunks for f, dt in c.dtypes.items()}
+                # Coerce the empties too, so an empty page carries the
+                # same unified dtypes as any non-empty read.
+                return {f: coerce(f, np.empty(0, dtype=dts.get(f, object)))
+                        for f in flds}
+            if len(parts) == 1:
+                return parts[0]
+            return {f: _concat([p[f] for p in parts]) for f in parts[0]}
+        finally:
+            with self._data_lock:
+                self._active_readers -= 1
+                if self._pending_gc and not self._active_readers:
+                    self._gc_locked()
+
+    @property
+    def over_budget(self) -> bool:
+        """True when column data exceeds the configured RAM budget — the
+        signal for switching from full consolidation to the shard-local
+        streamed design-matrix path (ops/preprocess.ChunkedDesign)."""
+        with self._data_lock:
+            return (self._ram_budget is not None
+                    and self._total_bytes_locked() > self._ram_budget)
 
     #: Most derived artifacts kept per dataset (each can pin a full design
     #: matrix, so the cap bounds resident memory in long-lived servers).

@@ -45,6 +45,15 @@ class Settings:
     ram_budget_mb: int = field(
         default_factory=lambda: _env("LO_TPU_RAM_BUDGET_MB", 0)
     )
+    #: Force the shard-local streamed design-matrix path for every build
+    #: (ops/preprocess.ChunkedDesign). Default off: builds stream
+    #: automatically when a dataset is over its RAM budget; this knob
+    #: forces it for testing / for pods whose datasets fit in RAM but
+    #: whose operators still want per-process residency divided by
+    #: process count.
+    stream_design: bool = field(
+        default_factory=lambda: _env("LO_TPU_STREAM_DESIGN", False, bool)
+    )
     #: Optional second directory mirroring every committed dataset (chunk
     #: files + journal + metadata). Standing in for the reference's Mongo
     #: primary/secondary replica set (docker-compose.yml:27-91): if the

@@ -20,6 +20,16 @@ import numpy as np
 from learningorchestra_tpu.parallel.mesh import MeshRuntime, host_rows
 
 
+def as_design(X):
+    """Normalize a trainer's X input: lazy designs (ChunkedDesign
+    protocol, recognized by ``.rows``) pass through untouched — calling
+    ``np.asarray`` on one would materialize the full matrix and defeat
+    shard-local loading; anything else becomes a float32 ndarray."""
+    if hasattr(X, "rows") and not isinstance(X, np.ndarray):
+        return X
+    return np.asarray(X, np.float32)
+
+
 @dataclass
 class TrainedModel:
     """A fitted classifier: replicated params + a jit'd probability fn."""
@@ -36,13 +46,15 @@ class TrainedModel:
     PREDICT_CHUNK = 2_000_000
 
     def predict_proba(self, runtime: MeshRuntime, X: np.ndarray) -> np.ndarray:
-        X = np.asarray(X, np.float32)
+        X = as_design(X)
         if len(X) <= self.PREDICT_CHUNK:
             X_dev, n = runtime.shard_rows(X)
             return host_rows(self.predict_proba_fn(self.params, X_dev))[:n]
         outs = []
         for i in range(0, len(X), self.PREDICT_CHUNK):
-            chunk = np.ascontiguousarray(X[i:i + self.PREDICT_CHUNK])
+            chunk = (X.rows(i, i + self.PREDICT_CHUNK)
+                     if hasattr(X, "rows")
+                     else np.ascontiguousarray(X[i:i + self.PREDICT_CHUNK]))
             X_dev, n = runtime.shard_rows(chunk)
             outs.append(
                 host_rows(self.predict_proba_fn(self.params, X_dev))[:n])

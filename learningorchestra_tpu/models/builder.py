@@ -83,6 +83,7 @@ class ModelBuilder:
         multi = spmd.is_multiprocess()
 
         pp_meta = None
+        streamed = False
         if preprocessor_code is not None:
             if multi:
                 raise PermissionError(
@@ -95,6 +96,24 @@ class ModelBuilder:
             X_train, y_train, X_test, y_test = preprocess.exec_preprocess(
                 preprocessor_code, train_ds, test_ds, label, cfg=self.cfg)
             feature_fields = [f"f{i}" for i in range(X_train.shape[1])]
+        elif (self.cfg.stream_design or train_ds.over_budget
+                or test_ds.over_budget):
+            # Shard-local streamed path: the design matrix never exists
+            # fully on any host — state is fitted with streaming passes
+            # and each device shard materializes only its own row range
+            # (preprocess.ChunkedDesign → mesh.shard_chunked). This is
+            # how fits scale past one host's RAM, the reference's
+            # executor residency model (model_builder.py:200). No memo:
+            # memoization consolidates, which is exactly what this path
+            # must never do.
+            streamed = True
+            X_train, y_train, feature_fields, state = \
+                preprocess.design_matrix_streamed(train_ds, label, steps)
+            X_test, y_test, _, _ = preprocess.design_matrix_streamed(
+                test_ds, label, steps, state=state,
+                feature_fields=feature_fields)
+            pp_meta = {"steps": list(steps), "state": state,
+                       "feature_fields": feature_fields, "label": label}
         else:
             # Memoized per dataset-snapshot: repeat builds on the same data
             # reuse the identical X arrays, so the runtime's transfer cache
@@ -184,6 +203,7 @@ class ModelBuilder:
                         "n_test": int(len(X_test)),
                         "state": spmd.jsonable_state(state),
                         "feature_fields": list(feature_fields),
+                        "streamed": streamed,
                     }):
                 return [fit_guarded(c) for c in classifiers]
 
@@ -215,14 +235,21 @@ class ModelBuilder:
         if not existing:
             self.store.create(out_name, parent=dataset,
                               extra={"model": model_name, "kind": man["kind"]})
+        streamed = ds.over_budget or self.cfg.stream_design
         with timed("model_predict"), device_trace(self.cfg):
-            X, _, _, _ = preprocess.design_matrix(
-                ds, pp["label"], pp["steps"], state=pp["state"],
-                feature_fields=pp["feature_fields"])
+            if streamed:
+                X, _, _, _ = preprocess.design_matrix_streamed(
+                    ds, pp["label"], pp["steps"], state=pp["state"],
+                    feature_fields=pp["feature_fields"], need_y=False)
+            else:
+                X, _, _, _ = preprocess.design_matrix(
+                    ds, pp["label"], pp["steps"], state=pp["state"],
+                    feature_fields=pp["feature_fields"])
             with spmd.dispatch_job(
                     self.store, (dataset,),
                     {"op": "predict", "model": model_name,
-                     "dataset": dataset, "n_rows": int(len(X))}):
+                     "dataset": dataset, "n_rows": int(len(X)),
+                     "streamed": streamed}):
                 probs = model.predict_proba(self.runtime, X)
         preds = np.argmax(probs, axis=1)
         self._save_predictions(out_name, ds, preds, probs,
@@ -235,15 +262,34 @@ class ModelBuilder:
         model_builder.py:191-248 drops 'features'/'rawPrediction' and
         converts the probability vector to a plain list)."""
         ds = self.store.get(name)
-        cols = {f: test_ds.columns[f] for f in test_ds.metadata.fields}
-        cols["prediction"] = preds.astype(np.int64)
-        # Object array of Python lists (np.array(list-of-lists, dtype=object)
-        # would build a 2-D array instead).
-        prob_col = np.empty(len(probs), dtype=object)
-        for i, p in enumerate(probs):
-            prob_col[i] = [float(x) for x in p]
-        cols["probability"] = prob_col
-        ds.append_columns(cols)
+        n = len(preds)
+
+        def prob_objcol(block_probs: np.ndarray) -> np.ndarray:
+            # Object array of Python lists (np.array(list-of-lists,
+            # dtype=object) would build a 2-D array instead).
+            out = np.empty(len(block_probs), dtype=object)
+            for i, p in enumerate(block_probs):
+                out[i] = [float(x) for x in p]
+            return out
+
+        if test_ds.over_budget or self.cfg.stream_design:
+            # Out-of-core test set (or forced streaming): write the
+            # prediction dataset in row blocks instead of consolidating
+            # the parent — the same predicate as every other
+            # streamed/resident decision, so LO_TPU_STREAM_DESIGN never
+            # re-introduces the O(dataset) host spike it exists to avoid.
+            block = 1 << 18
+            for off in range(0, n, block):
+                stop = min(off + block, n)
+                cols = test_ds.read_rows(None, off, stop)
+                cols["prediction"] = preds[off:stop].astype(np.int64)
+                cols["probability"] = prob_objcol(probs[off:stop])
+                ds.append_columns(cols)
+        else:
+            cols = {f: test_ds.columns[f] for f in test_ds.metadata.fields}
+            cols["prediction"] = preds.astype(np.int64)
+            cols["probability"] = prob_objcol(probs)
+            ds.append_columns(cols)
         self.store.finish(
             name,
             fit_time=report.fit_time,

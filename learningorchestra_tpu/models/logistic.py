@@ -227,7 +227,9 @@ def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
         num_classes: int, seed: int = 0, *, iters: int = 300,
         lr: float = 0.1, l2: float = 1e-4,
         solver: str = "auto") -> TrainedModel:
-    X = np.asarray(X, np.float32)
+    from learningorchestra_tpu.models.base import as_design
+
+    X = as_design(X)
     X_dev, n = runtime.shard_rows(X)
     y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
     n_dev = runtime.replicate(np.int32(n))

@@ -82,11 +82,23 @@ def make_train_step(opt):
 def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
         num_classes: int, seed: int = 0, *, hidden: int = 256,
         iters: int = 300, lr: float = 1e-2, l2: float = 1e-4) -> TrainedModel:
+    from learningorchestra_tpu.models.base import as_design
+
     mesh = runtime.mesh
-    X = np.asarray(X, np.float32)
-    mu = X.mean(axis=0).astype(np.float32)
-    sigma = np.where(X.std(axis=0) < 1e-7, 1.0, X.std(axis=0)).astype(
-        np.float32)
+    X = as_design(X)
+    X_dev, n = runtime.shard_rows(X)
+    if isinstance(X, np.ndarray):
+        mu = X.mean(axis=0).astype(np.float32)
+        sigma = np.where(X.std(axis=0) < 1e-7, 1.0, X.std(axis=0)).astype(
+            np.float32)
+    else:
+        # Lazy design (shard-local loading): the full matrix never exists
+        # on the host, so compute the identical masked stats on device
+        # (logistic's two-pass psum reduction).
+        from learningorchestra_tpu.models.logistic import _device_stats
+
+        mu, sigma = _device_stats(X_dev, runtime.replicate(np.int32(n)),
+                                  mesh=mesh)
     # Hidden dim must divide the model axis; round up.
     m = mesh.shape[MODEL_AXIS]
     hidden = ((hidden + m - 1) // m) * m
@@ -98,7 +110,6 @@ def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
     params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
               for k, v in params.items()}
 
-    X_dev, n = runtime.shard_rows(X)
     y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
     mask_dev, _ = runtime.shard_rows(
         (np.arange(len(X_dev)) < n).astype(np.float32))

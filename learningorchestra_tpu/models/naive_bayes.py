@@ -118,13 +118,19 @@ def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
     # reference's pyspark default is lambda = 1.0.
     if smoothing is None:
         smoothing = 1.0 if event_model == "multinomial" else 1e-3
-    X = np.asarray(X, np.float32)
-    if event_model == "multinomial" and X.size and float(X.min()) < 0.0:
-        raise ValueError(
-            "multinomial naive Bayes requires non-negative features "
-            "(counts); use the default gaussian event model for signed "
-            "continuous data")
+    from learningorchestra_tpu.models.base import as_design
+
+    X = as_design(X)
     X_dev, n = runtime.shard_rows(X)
+    if event_model == "multinomial" and X.shape[0] and X.shape[1]:
+        # Non-negativity check on device (padding rows are zeros, so they
+        # can't mask a negative): lazy designs never exist fully on the
+        # host, and the device min is one cheap reduction either way.
+        if float(np.asarray(jnp.min(X_dev))) < 0.0:
+            raise ValueError(
+                "multinomial naive Bayes requires non-negative features "
+                "(counts); use the default gaussian event model for signed "
+                "continuous data")
     y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
     if event_model == "multinomial":
         params = _fit_multinomial(

@@ -457,8 +457,14 @@ def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
                    max_depth, n_bins, mtry=None):
     if n_bins > 256:
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
-    X = np.asarray(X, np.float32)
-    edges = quantile_edges(X, n_bins)
+    from learningorchestra_tpu.models.base import as_design
+
+    X = as_design(X)
+    # Lazy designs never exist fully on the host: take the edge sample as
+    # strided range reads (quantile sketches over samples are the norm for
+    # histogram GBTs — the full-matrix path itself subsamples to 200k).
+    edges = quantile_edges(
+        X if isinstance(X, np.ndarray) else X.sample_rows(200_000), n_bins)
     # Shard the raw design matrix (one cached host→device transfer shared
     # with every other family in a multi-classifier build) and bin ON
     # DEVICE: binning is row-local, so the uint8 codes come out row-sharded
@@ -577,8 +583,11 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
                          "(as the reference's GBTClassifier)")
     if n_bins > 256:
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
-    X = np.asarray(X, np.float32)
-    edges = quantile_edges(X, n_bins)
+    from learningorchestra_tpu.models.base import as_design
+
+    X = as_design(X)
+    edges = quantile_edges(
+        X if isinstance(X, np.ndarray) else X.sample_rows(200_000), n_bins)
     # Same device-side binning as _fit_cls_trees: shard X (cached), bin
     # row-locally on device, no host round-trip of the bin matrix.
     X_dev, n = runtime.shard_rows(X)

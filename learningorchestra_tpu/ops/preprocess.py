@@ -167,6 +167,280 @@ def design_matrix(ds: Dataset, label: str,
     return X, y, feature_fields, state
 
 
+# -- shard-local streamed design path (VERDICT r4 #1) ------------------------
+#
+# The resident ``design_matrix`` consolidates the full dataset in host RAM
+# before sharding — on a pod that multiplies host-RAM cost by process count,
+# where the reference's executors each hold only their partitions
+# (model_builder.py:200). The streamed path splits the work:
+#
+#   1. ``_fit_design_state`` — fit every statistic the pipeline needs
+#      (label vocab, label-encode vocabs, fillna means, standardize stats)
+#      with STREAMING passes over ``iter_chunks``: one pass per fitting
+#      step, because step k+1's statistics are computed over step k's
+#      output (exactly the resident ``apply_steps`` fit order).
+#   2. ``ChunkedDesign`` — once fitted, every step is row-local, so any
+#      row range of the design matrix can be materialized independently.
+#      The mesh runtime builds each device shard from exactly its own row
+#      range (``mesh.shard_chunked``), so per-process peak host memory is
+#      O(local shard + one read block), never O(dataset).
+
+_DEFAULT_STEPS = ({"op": "label_encode"}, {"op": "fillna", "strategy": "mean"})
+
+#: Row-block size for streamed fitting passes; bounds per-pass host memory.
+_FIT_BLOCK_ROWS = 1 << 18
+
+
+def _iter_blocks(ds: Dataset, n_rows: int, fields=None):
+    """Stream the pinned row prefix ``[0, n_rows)`` chunk-by-chunk (the
+    final chunk trimmed), with iter_chunks' unified dtypes."""
+    got = 0
+    if n_rows <= 0:
+        return
+    for cols in ds.iter_chunks(fields):
+        if not cols:
+            continue
+        k = len(next(iter(cols.values())))
+        if got + k > n_rows:
+            take = n_rows - got
+            cols = {f: a[:take] for f, a in cols.items()}
+            k = take
+        if k:
+            yield cols
+        got += k
+        if got >= n_rows:
+            return
+
+
+def _apply_prefix_blocks(ds: Dataset, n_rows: int, label: str,
+                         prefix_steps, state):
+    """Stream blocks with the (already fully fitted) step prefix applied —
+    what the next fitting step's statistics are computed over."""
+    for cols in _iter_blocks(ds, n_rows):
+        cols.pop(label, None)
+        out, _ = apply_steps(cols, prefix_steps, state)
+        yield out
+
+
+def _encode_label_block(lab: np.ndarray, state: Dict) -> np.ndarray:
+    """One block of the label column → int32 codes, mirroring the resident
+    ``design_matrix`` label handling exactly (vocab must be pre-fitted)."""
+    if lab.dtype == object:
+        codes, _ = _label_encode(lab, state["__label_vocab__"])
+        return codes.astype(np.int32)
+    y = np.asarray(lab)
+    if y.dtype.kind == "f":
+        return np.where(np.isnan(y.astype(np.float64)), -1, y).astype(
+            np.int32)
+    return y.astype(np.int32)
+
+
+def _fit_label_vocab(ds: Dataset, label: str, n_rows: int) -> Dict[str, int]:
+    """Streaming label-vocab fit: sorted distinct keyed values — exactly
+    ``_label_encode``'s np.unique order over the full column."""
+    uniq: set = set()
+    for cols in _iter_blocks(ds, n_rows, [label]):
+        uniq.update("\0none" if v is None else str(v) for v in cols[label])
+    return {v: i for i, v in enumerate(sorted(uniq))}
+
+
+def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
+    """Streaming-fit all pipeline statistics; returns the fitted state.
+
+    Semantics match the resident fit per step: label vocab = sorted
+    distinct keyed values (np.unique's order), fillna means = nanmean,
+    standardize = two-pass mean/Σ(x−μ)² over finite values (the same
+    two-pass form the resident path uses — the one-pass E[x²]−E[x]² form
+    catastrophically cancels, see models/logistic._device_stats)."""
+    state: Dict[str, Any] = {}
+    if label in ds.metadata.fields and n_rows:
+        probe = ds.read_rows([label], 0, 1)[label]
+        if probe.dtype == object:
+            state["__label_vocab__"] = _fit_label_vocab(ds, label, n_rows)
+    for i, step in enumerate(steps):
+        op = step.get("op")
+        key = f"{i}:{op}"
+        prefix = steps[:i]
+        if op == "label_encode":
+            want = set(step.get("fields") or ())
+            vocab_sets: Dict[str, set] = {}
+            for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+                                             state):
+                for f, c in cols.items():
+                    if c.dtype == object and (not want or f in want):
+                        vocab_sets.setdefault(f, set()).update(
+                            "\0none" if v is None else str(v) for v in c)
+            state[key] = {f: {v: j for j, v in enumerate(sorted(s))}
+                          for f, s in vocab_sets.items()}
+        elif op == "fillna":
+            strategy = step.get("strategy", "mean")
+            if strategy == "mean":
+                sums: Dict[str, float] = {}
+                cnts: Dict[str, int] = {}
+                for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+                                                 state):
+                    for f, c in cols.items():
+                        if c.dtype.kind != "f":
+                            continue
+                        m = ~np.isnan(c)
+                        sums[f] = sums.get(f, 0.0) + float(
+                            c[m].sum(dtype=np.float64))
+                        cnts[f] = cnts.get(f, 0) + int(m.sum())
+                state[key] = {f: (sums[f] / cnts[f] if cnts[f] else 0.0)
+                              for f in sums}
+            elif strategy in ("zero", "value"):
+                val = 0.0 if strategy == "zero" else step["value"]
+                fill = {}
+                for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+                                                 state):
+                    fill.update({f: val for f, c in cols.items()
+                                 if c.dtype.kind == "f" and f not in fill})
+                    break       # dtypes are globally unified; one block
+                state[key] = fill
+            else:
+                raise PreprocessError(
+                    f"unknown fillna strategy {strategy!r}")
+        elif op == "standardize":
+            sums, cnts = {}, {}
+            for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+                                             state):
+                for f, c in cols.items():
+                    if c.dtype.kind not in "if":
+                        continue
+                    c64 = c.astype(np.float64)
+                    fin = np.isfinite(c64)
+                    sums[f] = sums.get(f, 0.0) + float(c64[fin].sum())
+                    cnts[f] = cnts.get(f, 0) + int(fin.sum())
+            mus = {f: (sums[f] / cnts[f] if cnts[f] else 0.0) for f in sums}
+            sq = {f: 0.0 for f in sums}
+            for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+                                             state):
+                for f, c in cols.items():
+                    if f not in sq:
+                        continue
+                    c64 = c.astype(np.float64)
+                    fin = np.isfinite(c64)
+                    d = c64[fin] - mus[f]
+                    sq[f] += float((d * d).sum())
+            stats = {}
+            for f in sums:
+                if cnts[f]:
+                    mu = mus[f]
+                    sd = float(np.sqrt(sq[f] / cnts[f]))
+                else:
+                    mu, sd = 0.0, 1.0
+                if not np.isfinite(sd) or sd == 0.0:
+                    sd = 1.0
+                stats[f] = (mu, sd)
+            state[key] = stats
+        # select / drop / cast fit nothing
+    return state
+
+
+class ChunkedDesign:
+    """Lazily-materialized (n, d) float32 design matrix over the chunk
+    store — quacks enough like an ndarray (shape/len/dtype) for the
+    trainer surface while materializing rows only on demand.
+
+    ``rows(start, stop)`` reads just the chunks overlapping the range
+    (Dataset.read_rows) and applies the FITTED pipeline, which is row-local
+    by construction. ``MeshRuntime.shard_rows`` recognizes this type and
+    builds each device shard from exactly its own row range, so a pod
+    process's peak host memory is its local shard — the reference's
+    executor data residency (model_builder.py:200) rather than N copies of
+    the full matrix. Treat as immutable: it pins ``n_rows`` so appends
+    after construction never shift its rows."""
+
+    def __init__(self, ds: Dataset, label: str, steps, state,
+                 feature_fields, n_rows: int):
+        self.ds = ds
+        self.label = label
+        self.steps = [dict(s) for s in steps]
+        self.state = state
+        self.feature_fields = list(feature_fields)
+        self.shape = (int(n_rows), len(self.feature_fields))
+        self.dtype = np.dtype(np.float32)
+        # Only the columns the pipeline actually touches are read per
+        # block: the features plus every explicitly-referenced step field.
+        need = set(self.feature_fields)
+        for s in self.steps:
+            need.update(s.get("fields") or ())
+        self._input_fields = [f for f in ds.metadata.fields if f in need]
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    @property
+    def nbytes(self) -> int:
+        return self.shape[0] * self.shape[1] * 4
+
+    def rows(self, start: int, stop: int) -> np.ndarray:
+        start = max(0, int(start))
+        stop = min(int(stop), self.shape[0])
+        if not self.feature_fields:
+            return np.zeros((max(stop - start, 0), 0), np.float32)
+        cols = self.ds.read_rows(self._input_fields, start, stop)
+        cols.pop(self.label, None)
+        cols, _ = apply_steps(cols, self.steps, self.state)
+        return np.stack([np.asarray(cols[f], np.float32)
+                         for f in self.feature_fields], axis=1)
+
+    def sample_rows(self, max_rows: int = 1 << 18) -> np.ndarray:
+        """Evenly-strided row sample for statistics that genuinely need
+        host rows (e.g. tree quantile edges — approximate sketches are the
+        norm for histogram GBTs)."""
+        n = self.shape[0]
+        if n <= max_rows:
+            return self.rows(0, n)
+        blocks = 64
+        per = max(1, max_rows // blocks)
+        starts = np.linspace(0, n - per, blocks).astype(np.int64)
+        return np.concatenate(
+            [self.rows(int(s), int(s) + per) for s in starts], axis=0)
+
+
+def design_matrix_streamed(ds: Dataset, label: str,
+                           steps: Sequence[Dict[str, Any]] = (),
+                           state: Optional[Dict] = None,
+                           feature_fields: Optional[List[str]] = None,
+                           n_rows: Optional[int] = None,
+                           need_y: bool = True):
+    """Streamed analogue of ``design_matrix``: same return contract
+    ``(X, y, feature_fields, state)`` but X is a :class:`ChunkedDesign`
+    and nothing consolidates the dataset. ``state=None`` fits it with
+    streaming passes; a provided state (the test set / SPMD-worker path)
+    is applied as-is. ``n_rows`` pins the row snapshot (SPMD workers pin
+    to the dispatched spec's counts). ``need_y=False`` (the predict
+    paths, which discard y) skips the label-column scan entirely."""
+    total = ds.num_rows
+    n_rows = total if n_rows is None else min(int(n_rows), total)
+    steps = [dict(s) for s in steps] or [dict(s) for s in _DEFAULT_STEPS]
+    if state is None:
+        state = _fit_design_state(ds, label, steps, n_rows)
+    else:
+        state = dict(state)
+    y = None
+    if need_y and label in ds.metadata.fields:
+        if (n_rows and "__label_vocab__" not in state
+                and ds.read_rows([label], 0, 1)[label].dtype == object):
+            # Apply-with-given-state path on an object label whose vocab
+            # was never fitted (possible only if the train set lacked the
+            # label column): fit it here, as the resident path would.
+            state["__label_vocab__"] = _fit_label_vocab(ds, label, n_rows)
+        parts = [_encode_label_block(cols[label], state)
+                 for cols in _iter_blocks(ds, n_rows, [label])]
+        y = (np.concatenate(parts) if parts
+             else np.empty(0, dtype=np.int32))
+    if feature_fields is None:
+        sample = ds.read_rows(None, 0, min(n_rows, 1024))
+        sample.pop(label, None)
+        sampled, _ = apply_steps(sample, steps, state)
+        feature_fields = [f for f in sampled
+                          if sampled[f].dtype.kind in "ifub"]
+    X = ChunkedDesign(ds, label, steps, state, feature_fields, n_rows)
+    return X, y, list(feature_fields), state
+
+
 def exec_preprocess(code: str, train_ds: Dataset, test_ds: Dataset,
                     label: str, cfg=None):
     """Flag-gated exec path (reference model_builder.py:145-150), run in a

@@ -109,6 +109,42 @@ def shard_rows(mesh: Mesh, arr: np.ndarray) -> Tuple[jax.Array, int]:
     return out, n
 
 
+def shard_chunked(mesh: Mesh, design) -> Tuple[jax.Array, int]:
+    """Row-shard a LAZY design matrix (ops/preprocess.ChunkedDesign
+    protocol: ``.shape``/``.dtype``/``.rows(start, stop)``) without ever
+    materializing it fully on the host.
+
+    ``jax.make_array_from_callback`` asks for each addressable shard's
+    index; the callback materializes exactly that row range from the chunk
+    store. On a pod each process therefore reads only its OWN shards —
+    host-RAM cost divides by process count instead of multiplying
+    (VERDICT r4 #1; the reference's executors likewise hold only their
+    partitions, model_builder.py:200). Tail padding rows are zeros, masked
+    by ``row < n`` downstream exactly like ``shard_rows``."""
+    n = int(design.shape[0])
+    n_shards = mesh.shape[DATA_AXIS]
+    padded_n = n + (-n) % n_shards
+    tail = tuple(int(s) for s in design.shape[1:])
+    sharding = NamedSharding(mesh, P(DATA_AXIS, *([None] * len(tail))))
+    dtype = np.dtype(getattr(design, "dtype", np.float32))
+
+    def cb(idx):
+        rs = idx[0]
+        start = rs.start or 0
+        stop = padded_n if rs.stop is None else rs.stop
+        parts = []
+        if start < n:
+            parts.append(np.ascontiguousarray(
+                np.asarray(design.rows(start, min(stop, n)), dtype)))
+        pad = stop - max(start, n)
+        if pad > 0:
+            parts.append(np.zeros((pad,) + tail, dtype))
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+
+    out = jax.make_array_from_callback((padded_n,) + tail, sharding, cb)
+    return out, n
+
+
 def replicate(mesh: Mesh, x) -> jax.Array:
     """Replicate a value across every mesh device (fully-replicated spec)."""
     x = np.asarray(x)
@@ -174,6 +210,28 @@ class MeshRuntime:
         from learningorchestra_tpu.parallel import spmd
 
         spmd.check_mesh_entry("shard_rows")
+        if hasattr(arr, "rows") and not isinstance(arr, np.ndarray):
+            # Lazy design matrix (ChunkedDesign protocol): device shards
+            # materialize from per-shard range reads; cache by identity
+            # like host arrays (a 5-classifier build shards the same
+            # design five times). Designs pin their row snapshot at
+            # construction, so identity-keyed caching is sound.
+            key = ("design", id(arr))
+            with self._lock:
+                hit = self._transfer_cache.get(key)
+            if hit is not None:
+                return hit
+            out = shard_chunked(self.mesh, arr)
+            with self._lock:
+                self._transfer_cache[key] = out
+
+                def _evict_d(cache=self._transfer_cache, key=key,
+                             lock=self._lock):
+                    with lock:
+                        cache.pop(key, None)
+
+                weakref.finalize(arr, _evict_d)
+            return out
         if not isinstance(arr, np.ndarray):
             return shard_rows(self.mesh, arr)
         key = (id(arr), arr.shape, str(arr.dtype))

@@ -387,17 +387,32 @@ def prep_build_job(store, runtime, spec: Dict[str, Any]):
     hparams = spec.get("hparams") or {}
     state = spec.get("state")
     ff = spec.get("feature_fields")
-    X_train, y_train, ff, state = preprocess.design_matrix(
-        train_ds, label, steps, state=state, feature_fields=ff)
-    X_test, y_test, _, _ = preprocess.design_matrix(
-        test_ds, label, steps, state=state, feature_fields=ff)
     n_train, n_test = spec.get("n_train"), spec.get("n_test")
-    if n_train is not None:
-        _require_snapshot(len(X_train), n_train, "train rows")
-        _require_snapshot(len(X_test), n_test, "test rows")
-        X_train, y_train = X_train[:n_train], y_train[:n_train]
-        X_test = X_test[:n_test]
-        y_test = y_test[:n_test] if y_test is not None else None
+    if spec.get("streamed"):
+        # Mirror process 0's shard-local path: the same pinned state +
+        # feature fields + row counts make every process's lazy design
+        # identical, and each worker's device shards materialize from its
+        # OWN row ranges only — the whole point of streaming (host RAM
+        # divides by process count).
+        _require_snapshot(train_ds.num_rows, n_train, "train rows")
+        _require_snapshot(test_ds.num_rows, n_test, "test rows")
+        X_train, y_train, ff, state = preprocess.design_matrix_streamed(
+            train_ds, label, steps, state=state, feature_fields=ff,
+            n_rows=n_train)
+        X_test, y_test, _, _ = preprocess.design_matrix_streamed(
+            test_ds, label, steps, state=state, feature_fields=ff,
+            n_rows=n_test)
+    else:
+        X_train, y_train, ff, state = preprocess.design_matrix(
+            train_ds, label, steps, state=state, feature_fields=ff)
+        X_test, y_test, _, _ = preprocess.design_matrix(
+            test_ds, label, steps, state=state, feature_fields=ff)
+        if n_train is not None:
+            _require_snapshot(len(X_train), n_train, "train rows")
+            _require_snapshot(len(X_test), n_test, "test rows")
+            X_train, y_train = X_train[:n_train], y_train[:n_train]
+            X_test = X_test[:n_test]
+            y_test = y_test[:n_test] if y_test is not None else None
     num_classes = int(max(int(y_train.max()) + 1,
                           2 if y_test is None else int(y_test.max()) + 1))
 
@@ -424,13 +439,19 @@ def prep_predict_job(store, runtime, spec: Dict[str, Any]):
     man, model = registry.load(spec["model"])
     pp = man["preprocess"]
     ds = store.load(spec["dataset"])
-    X, _, _, _ = preprocess.design_matrix(
-        ds, pp["label"], pp["steps"], state=pp["state"],
-        feature_fields=pp["feature_fields"])
     n = spec.get("n_rows")
-    if n is not None:
-        _require_snapshot(len(X), n, "rows")
-        X = X[:n]
+    if spec.get("streamed"):
+        _require_snapshot(ds.num_rows, n, "rows")
+        X, _, _, _ = preprocess.design_matrix_streamed(
+            ds, pp["label"], pp["steps"], state=pp["state"],
+            feature_fields=pp["feature_fields"], n_rows=n, need_y=False)
+    else:
+        X, _, _, _ = preprocess.design_matrix(
+            ds, pp["label"], pp["steps"], state=pp["state"],
+            feature_fields=pp["feature_fields"])
+        if n is not None:
+            _require_snapshot(len(X), n, "rows")
+            X = X[:n]
     return lambda: model.predict_proba(runtime, X)
 
 

@@ -60,6 +60,12 @@ def test_two_process_model_build(tmp_path):
     assert result["nb"]["f1"] > 0.85, result
     assert result["lr"]["pred_rows"] == 1000
     assert "error" not in result["lr"] and "error" not in result["nb"]
+    # The shard-local streamed build (each process materializes only its
+    # own row ranges) matches the resident build's quality on the pod.
+    assert "error" not in result["streamed_lr"], result
+    assert result["streamed_lr"]["pred_rows"] == 1000
+    assert abs(result["streamed_lr"]["f1"] - result["lr"]["f1"]) < 1e-6, \
+        result
     # The rest of the API surface ran on the pod too.
     assert os.path.isfile(result["pca_png"]), result
     assert os.path.isfile(result["tsne_png"]), result
