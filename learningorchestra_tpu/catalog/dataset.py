@@ -32,6 +32,7 @@ clients can fail fast.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -811,6 +812,26 @@ class Dataset:
 
         return _coerce
 
+    @contextlib.contextmanager
+    def snapshot(self, max_chunks: Optional[int] = None):
+        """Pin ONE chunk snapshot for multiple reads: every ``read``/
+        ``scan`` through the yielded :class:`SnapshotReader` sees the same
+        chunk generation, so a paged response evaluated block-by-block can
+        never mix pre- and post-``set_column``-rewrite values. Registers
+        as an active reader for its lifetime (chunk-file GC defers)."""
+        with self._data_lock:
+            chunks = list(self._chunks)
+            if max_chunks is not None:
+                chunks = chunks[:max_chunks]
+            self._active_readers += 1
+        try:
+            yield SnapshotReader(self, chunks)
+        finally:
+            with self._data_lock:
+                self._active_readers -= 1
+                if self._pending_gc and not self._active_readers:
+                    self._gc_locked()
+
     def read_rows(self, fields: Optional[List[str]] = None,
                   start: int = 0, stop: Optional[int] = None,
                   max_chunks: Optional[int] = None) -> Columns:
@@ -823,47 +844,8 @@ class Dataset:
         model_builder.py:200). Dtypes are unified exactly as
         ``iter_chunks``/consolidation would, so a range read never sees
         chunk-local dtype drift."""
-        with self._data_lock:
-            chunks = list(self._chunks)
-            if max_chunks is not None:
-                chunks = chunks[:max_chunks]
-            self._active_readers += 1
-        try:
-            coerce = self._make_coercer(chunks, fields)
-            total = sum(c.n_rows for c in chunks)
-            stop = total if stop is None else min(stop, total)
-            start = max(0, min(start, stop))
-            parts: List[Columns] = []
-            off = 0
-            for c in chunks:
-                end = off + c.n_rows
-                if end > start and off < stop:
-                    cols = c.materialize(fields)
-                    lo, hi = max(start - off, 0), min(stop - off, c.n_rows)
-                    # Slice BEFORE coercing: the coercer is elementwise,
-                    # and coercing a whole 256k-row chunk to return a
-                    # 10-row page would make page reads O(chunk).
-                    parts.append({f: coerce(f, a[lo:hi])
-                                  for f, a in cols.items()})
-                off = end
-                if off >= stop:
-                    break
-            if not parts:
-                flds = (fields if fields is not None
-                        else list(self.metadata.fields))
-                dts = {f: dt for c in chunks for f, dt in c.dtypes.items()}
-                # Coerce the empties too, so an empty page carries the
-                # same unified dtypes as any non-empty read.
-                return {f: coerce(f, np.empty(0, dtype=dts.get(f, object)))
-                        for f in flds}
-            if len(parts) == 1:
-                return parts[0]
-            return {f: _concat([p[f] for p in parts]) for f in parts[0]}
-        finally:
-            with self._data_lock:
-                self._active_readers -= 1
-                if self._pending_gc and not self._active_readers:
-                    self._gc_locked()
+        with self.snapshot(max_chunks) as snap:
+            return snap.read(fields, start, stop)
 
     @property
     def over_budget(self) -> bool:
@@ -1109,12 +1091,89 @@ def stringify_numeric(a: np.ndarray) -> np.ndarray:
     return out
 
 
-def rows_from(cols: Columns, fields: List[str],
-              indices: np.ndarray) -> List[Dict[str, Any]]:
-    """Materialize row docs from a column snapshot (lock-free)."""
+class SnapshotReader:
+    """Row reads over one pinned chunk snapshot (``Dataset.snapshot``).
+
+    All reads through one instance see the same chunk generation —
+    ``set_column`` rewrites replace the dataset's chunk list, but never
+    this captured one (the enclosing context's active-reader registration
+    keeps the chunk files alive). Coercers are cached per field-selection
+    so repeated scans/reads don't re-derive dtype unification."""
+
+    def __init__(self, ds: "Dataset", chunks: List["_Chunk"]):
+        self._ds = ds
+        self._chunks = chunks
+        self.n_rows = sum(c.n_rows for c in chunks)
+        self._coercers: Dict[Any, Any] = {}
+
+    def _coercer(self, fields: Optional[List[str]]):
+        key = None if fields is None else tuple(fields)
+        got = self._coercers.get(key)
+        if got is None:
+            got = Dataset._make_coercer(self._chunks, fields)
+            self._coercers[key] = got
+        return got
+
+    def read(self, fields: Optional[List[str]], start: int,
+             stop: Optional[int]) -> Columns:
+        """Rows ``[start, stop)`` — materializes only overlapping chunks,
+        slicing before coercion (O(range), not O(chunk))."""
+        coerce = self._coercer(fields)
+        stop = self.n_rows if stop is None else min(stop, self.n_rows)
+        start = max(0, min(start, stop))
+        parts: List[Columns] = []
+        off = 0
+        for c in self._chunks:
+            end = off + c.n_rows
+            if end > start and off < stop:
+                cols = c.materialize(fields)
+                lo, hi = max(start - off, 0), min(stop - off, c.n_rows)
+                parts.append({f: coerce(f, a[lo:hi])
+                              for f, a in cols.items()})
+            off = end
+            if off >= stop:
+                break
+        if not parts:
+            flds = (fields if fields is not None
+                    else list(self._ds.metadata.fields))
+            dts = {f: dt for c in self._chunks
+                   for f, dt in c.dtypes.items()}
+            # Coerce the empties too, so an empty page carries the same
+            # unified dtypes as any non-empty read.
+            return {f: coerce(f, np.empty(0, dtype=dts.get(f, object)))
+                    for f in flds}
+        if len(parts) == 1:
+            return parts[0]
+        return {f: _concat([p[f] for p in parts]) for f in parts[0]}
+
+    def scan(self, fields: Optional[List[str]] = None,
+             block_rows: int = 1 << 16):
+        """Yield ``(offset, n_block, cols)`` row blocks over the snapshot
+        — each chunk materialized once, split into ≤``block_rows`` pieces.
+        ``fields`` projects columns (a filtered read scans only the
+        query's fields); ``cols`` may be empty when ``fields`` is, which
+        is why the block length is yielded explicitly."""
+        coerce = self._coercer(fields)
+        off = 0
+        for c in self._chunks:
+            cols = None
+            for s in range(0, c.n_rows, block_rows):
+                e = min(s + block_rows, c.n_rows)
+                if cols is None:
+                    cols = c.materialize(fields)
+                yield (off + s, e - s,
+                       {f: coerce(f, a[s:e]) for f, a in cols.items()})
+            off += c.n_rows
+
+
+def rows_from(cols: Columns, fields: List[str], indices: np.ndarray,
+              id_offset: int = 0) -> List[Dict[str, Any]]:
+    """Materialize row docs from a column snapshot (lock-free).
+    ``id_offset`` shifts ``_id`` for block-streamed reads, where ``cols``
+    holds a row range starting at that global offset."""
     out = []
     for i in indices:
-        doc = {"_id": int(i) + 1}
+        doc = {"_id": int(i) + 1 + id_offset}
         for f in fields:
             doc[f] = _pyval(cols[f][i])
         out.append(doc)

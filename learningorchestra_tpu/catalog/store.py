@@ -49,6 +49,10 @@ class DatasetExists(ValueError):
 #: REST API, so they must never traverse paths.
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.\-]*$")
 
+#: Row-block size for streamed filtered reads — bounds per-request host
+#: memory while amortizing per-block query-evaluation overhead.
+_READ_BLOCK_ROWS = 1 << 16
+
 
 def validate_name(name: str) -> str:
     if not isinstance(name, str) or not _NAME_RE.match(name) or ".." in name:
@@ -241,28 +245,63 @@ class DatasetStore:
             # and must stay O(1) — consolidating an out-of-core dataset to
             # answer it would read every chunk from disk.
             return docs
-        # One consistent snapshot for the whole read: ds.columns is an
-        # immutable consolidation, so mask lengths and row materialization
-        # can't diverge even while an ingest job is appending.
-        cols = ds.columns
-        idx = self._query_indices(cols, ds.metadata.fields, query)
-        # Apply skip/limit on indices BEFORE materializing row dicts (the
-        # reference pushed skip/limit into the Mongo cursor,
-        # database.py:107-111).
         row_skip = max(0, skip - n_meta)
         remaining = limit - len(docs)
-        idx = idx[row_skip:row_skip + remaining] if remaining > 0 else idx[:0]
-        docs.extend(_rows_from(cols, ds.metadata.fields, idx))
-        return docs
+        if remaining <= 0:
+            return docs
+        fields = ds.metadata.fields
+        # Row reads never consolidate: only the chunks overlapping each
+        # requested range are touched, so paging a spilled 50M-row dataset
+        # reads O(page) — the reference pushed skip/limit into the Mongo
+        # cursor for the same reason (database.py:107-111). The whole
+        # request runs over ONE pinned chunk snapshot: a concurrent
+        # set_column generation rewrite can never mix pre- and
+        # post-rewrite values within a single response.
+        with ds.snapshot() as snap:
+            if not query:
+                stop = min(row_skip + remaining, snap.n_rows)
+                block = snap.read(None, row_skip, stop)
+                k = len(next(iter(block.values()))) if block else 0
+                docs.extend(_rows_from(block, fields, np.arange(k),
+                                       id_offset=row_skip))
+                return docs
+            # Filtered read: scan only the QUERY's columns block-by-block
+            # (with each block's global ``_id`` offset), stop as soon as
+            # skip+limit matches are found, and fetch full rows just for
+            # the matches — a selective 1-column predicate over a wide
+            # dataset never decompresses the other columns of
+            # non-matching blocks.
+            to_skip = row_skip
+            for off, n_blk, block in snap.scan(_query_fields(query, fields)):
+                idx = self._query_indices(block, fields, query,
+                                          id_offset=off, n=n_blk)
+                if to_skip:
+                    dropped = min(to_skip, len(idx))
+                    idx = idx[dropped:]
+                    to_skip -= dropped
+                take = idx[:remaining]
+                if len(take):
+                    g = take + off
+                    lo, hi = int(g.min()), int(g.max()) + 1
+                    full = snap.read(None, lo, hi)
+                    docs.extend(_rows_from(full, fields, g - lo,
+                                           id_offset=lo))
+                    remaining -= len(take)
+                if remaining <= 0:
+                    break
+            return docs
 
     @staticmethod
-    def _query_indices(cols, fields: List[str],
-                       query: Dict[str, Any]) -> np.ndarray:
-        n = len(next(iter(cols.values()))) if cols else 0
+    def _query_indices(cols, fields: List[str], query: Dict[str, Any],
+                       id_offset: int = 0,
+                       n: Optional[int] = None) -> np.ndarray:
+        if n is None:
+            n = len(next(iter(cols.values()))) if cols else 0
 
         def resolve(field: str):
             if field == "_id":
-                return np.arange(1, n + 1), np.ones(n, dtype=bool)
+                return (np.arange(id_offset + 1, id_offset + n + 1),
+                        np.ones(n, dtype=bool))
             if field in cols:
                 vals = cols[field]
                 if vals.dtype == object:
@@ -567,6 +606,27 @@ _MATCH_MISSING = {"$ne", "$nin"}
 
 _REGEX_FLAGS = {"i": re.IGNORECASE, "m": re.MULTILINE, "s": re.DOTALL,
                 "x": re.VERBOSE}
+
+
+def _query_fields(query: Dict[str, Any],
+                  fields: List[str]) -> List[str]:
+    """Root column names a Mongo-style query touches (dotted paths keep
+    their root; ``_id`` is positional and needs no column) — the
+    projection a filtered scan reads instead of every column."""
+    out: set = set()
+
+    def walk(q) -> None:
+        if not isinstance(q, dict):
+            return
+        for k, v in q.items():
+            if k in ("$and", "$or", "$nor"):
+                for sub in (v if isinstance(v, (list, tuple)) else ()):
+                    walk(sub)
+            elif not k.startswith("$") and k != "_id":
+                out.add(k.split(".", 1)[0])
+
+    walk(query)
+    return [f for f in fields if f in out]
 
 
 def _traverse(value: Any, path: str):

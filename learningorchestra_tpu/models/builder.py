@@ -204,7 +204,9 @@ class ModelBuilder:
                         "state": spmd.jsonable_state(state),
                         "feature_fields": list(feature_fields),
                         "streamed": streamed,
-                    }):
+                    },
+                    outputs=[f"{prediction_name}_{c}"
+                             for c in classifiers]):
                 return [fit_guarded(c) for c in classifiers]
 
         # Concurrent fits (reference: 5-way ThreadPoolExecutor + FAIR pool).
@@ -249,7 +251,8 @@ class ModelBuilder:
                     self.store, (dataset,),
                     {"op": "predict", "model": model_name,
                      "dataset": dataset, "n_rows": int(len(X)),
-                     "streamed": streamed}):
+                     "streamed": streamed},
+                    outputs=(out_name,)):
                 probs = model.predict_proba(self.runtime, X)
         preds = np.argmax(probs, axis=1)
         self._save_predictions(out_name, ds, preds, probs,

@@ -31,7 +31,7 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
-from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.models.base import TrainedModel, as_design
 from learningorchestra_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 
 
@@ -227,7 +227,6 @@ def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
         num_classes: int, seed: int = 0, *, iters: int = 300,
         lr: float = 0.1, l2: float = 1e-4,
         solver: str = "auto") -> TrainedModel:
-    from learningorchestra_tpu.models.base import as_design
 
     X = as_design(X)
     X_dev, n = runtime.shard_rows(X)

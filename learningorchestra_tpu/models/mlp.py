@@ -22,7 +22,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.models.base import TrainedModel, as_design
 from learningorchestra_tpu.parallel.mesh import (
     DATA_AXIS, MODEL_AXIS, MeshRuntime)
 
@@ -82,7 +82,6 @@ def make_train_step(opt):
 def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
         num_classes: int, seed: int = 0, *, hidden: int = 256,
         iters: int = 300, lr: float = 1e-2, l2: float = 1e-4) -> TrainedModel:
-    from learningorchestra_tpu.models.base import as_design
 
     mesh = runtime.mesh
     X = as_design(X)

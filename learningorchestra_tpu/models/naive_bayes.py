@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.models.base import TrainedModel, as_design
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
 
 _VAR_FLOOR = 1e-6
@@ -118,7 +118,6 @@ def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
     # reference's pyspark default is lambda = 1.0.
     if smoothing is None:
         smoothing = 1.0 if event_model == "multinomial" else 1e-3
-    from learningorchestra_tpu.models.base import as_design
 
     X = as_design(X)
     X_dev, n = runtime.shard_rows(X)

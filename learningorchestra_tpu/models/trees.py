@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from learningorchestra_tpu.models.base import TrainedModel
+from learningorchestra_tpu.models.base import TrainedModel, as_design
 from learningorchestra_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 
 NEG = -1e30
@@ -457,7 +457,6 @@ def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
                    max_depth, n_bins, mtry=None):
     if n_bins > 256:
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
-    from learningorchestra_tpu.models.base import as_design
 
     X = as_design(X)
     # Lazy designs never exist fully on the host: take the edge sample as
@@ -583,7 +582,6 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
                          "(as the reference's GBTClassifier)")
     if n_bins > 256:
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
-    from learningorchestra_tpu.models.base import as_design
 
     X = as_design(X)
     edges = quantile_edges(

@@ -181,7 +181,7 @@ def create_histogram(store: DatasetStore, runtime: MeshRuntime,
         return {"op": "histogram", "parent": parent,
                 "fields": list(fields), "n_chunks": pin["n_chunks"]}
 
-    with spmd.dispatch_job(store, (parent,), make_spec):
+    with spmd.dispatch_job(store, (parent,), make_spec, outputs=(name,)):
         totals = histogram_totals(runtime, parent_ds, fields,
                                   max_chunks=pin.get("n_chunks"))
     ds.append_rows([{"field": f, "counts": totals[f]} for f in fields])

@@ -191,6 +191,12 @@ class _JobChannel:
             if status == "eof":
                 self._drop(conn)
                 failures.append("worker died before ack")
+                # A dead worker can never rejoin: poison the pod NOW so
+                # later dispatches refuse immediately instead of each
+                # burning the full connect timeout against a permanently
+                # short-handed pod (same rule as mid-job deaths).
+                global _pod_error
+                _pod_error = "worker died before ack"
             elif status == "timeout":
                 failures.append(
                     f"worker ack timed out after {prep_timeout_s:.0f}s")
@@ -207,10 +213,38 @@ class _JobChannel:
         """Fire-and-forget control message (shutdown) — no ack round."""
         self._sendall(self._live(), msg)
 
+    def monitor_workers(self, stop: threading.Event, on_death) -> None:
+        """Poll worker sockets for EOF (MSG_PEEK — never consumes ack
+        bytes) while a dispatched job computes. A worker dying after 'go'
+        used to be a silent pod wedge (the surviving processes block in a
+        collective forever); the monitor converts it into a detected
+        failure: ``on_death(reason)`` fires once, the caller fails the
+        job's output datasets (pollable) and poisons the pod for fast
+        failure of subsequent jobs."""
+        while not stop.is_set():
+            for conn in self._live():
+                try:
+                    data = conn.sock.recv(
+                        1, socket.MSG_PEEK | socket.MSG_DONTWAIT)
+                    if data == b"":
+                        on_death("worker process died mid-job")
+                        return
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    on_death("worker connection lost mid-job")
+                    return
+            stop.wait(0.2)
+
 
 _channel: Optional[_JobChannel] = None
 _channel_lock = threading.Lock()
 _dispatch_lock = threading.Lock()
+#: Set to a reason string when a worker died mid-job. A dead worker can
+#: never rejoin a running pod (its jax.distributed identity died with it),
+#: so once set every subsequent dispatch fails fast with this reason
+#: instead of timing out against a permanently short-handed pod.
+_pod_error: Optional[str] = None
 #: Thread-local mesh-job scope: set while this thread is allowed to enter
 #: mesh collectives on a multi-process pod (process 0 inside dispatch_guard,
 #: workers while executing a dispatched job's device ops).
@@ -270,6 +304,18 @@ def ensure_channel() -> None:
         _get_channel()
 
 
+def pod_error() -> Optional[str]:
+    """The reason this pod is permanently degraded, or None while healthy."""
+    return _pod_error
+
+
+def _check_pod_health() -> None:
+    if _pod_error is not None:
+        raise RuntimeError(
+            f"pod is degraded ({_pod_error}); a dead worker cannot rejoin "
+            "a running pod — restart the pod (deploy/run_pod.sh)")
+
+
 def dispatch(spec: Dict[str, Any]) -> None:
     """Process-0 side: announce the next mesh job to every worker and
     rendezvous on their readiness. No-op single-process. Caller must then
@@ -277,18 +323,28 @@ def dispatch(spec: Dict[str, Any]) -> None:
     spec."""
     if not is_multiprocess():
         return
+    _check_pod_health()
     _get_channel().dispatch(spec)
 
 
 @contextlib.contextmanager
-def dispatch_job(store, inputs, make_spec):
+def dispatch_job(store, inputs, make_spec, outputs=()):
     """Process-0 preamble shared by every dispatched surface (build,
     predict, embed, histogram): require a persisted shared store, commit
     the input datasets workers rebuild from, serialize the mesh job, and
     dispatch the spec — then run the caller's device ops inside the mesh
     scope. ``make_spec`` may be the spec dict or a thunk evaluated *after*
     the saves (specs that pin journaled state need the post-save view).
-    Single-process: plain passthrough (no guard, jobs stay overlapped)."""
+    Single-process: plain passthrough (no guard, jobs stay overlapped).
+
+    ``outputs`` names the job's output datasets. While the device ops run,
+    a watchdog thread peeks every worker socket: a worker dying after 'go'
+    wedges the surviving processes in a collective (inherent to
+    collectives without timeouts), but the watchdog converts it from a
+    SILENT wedge into a recorded failure — each output dataset flips to
+    ``finished: true`` with a pollable ``error``, and the pod is poisoned
+    so every later dispatch fails fast instead of timing out against a
+    permanently short-handed pod."""
     if not is_multiprocess():
         yield
         return
@@ -297,11 +353,38 @@ def dispatch_job(store, inputs, make_spec):
         raise RuntimeError(
             f"multi-process {op} jobs require a persisted shared store "
             "(LO_TPU_PERSIST=1 on a shared store_root)")
+    _check_pod_health()
     for name in inputs:
         store.save(name)
     with dispatch_guard():
         dispatch(make_spec() if callable(make_spec) else make_spec)
-        yield
+        stop = threading.Event()
+
+        def on_death(reason: str) -> None:
+            global _pod_error
+            _pod_error = reason
+            log.error("pod degraded: %s — failing job outputs %s",
+                      reason, list(outputs))
+            for name in outputs:
+                try:
+                    store.fail(name, f"pod failure: {reason}")
+                except Exception:  # noqa: BLE001 — best-effort flagging
+                    log.exception("could not fail output %s", name)
+
+        monitor = threading.Thread(
+            target=_get_channel().monitor_workers, args=(stop, on_death),
+            daemon=True, name="lo-spmd-watchdog")
+        monitor.start()
+        try:
+            yield
+        finally:
+            stop.set()
+            monitor.join(timeout=2.0)
+        # The compute may have completed on this process even though a
+        # worker died (death after its last collective): the outputs were
+        # already flagged failed, so surface the degradation to the caller
+        # rather than silently persisting half-a-pod's results.
+        _check_pod_health()
 
 
 class dispatch_guard:

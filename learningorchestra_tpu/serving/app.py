@@ -324,7 +324,7 @@ class App:
                 create_embedding_image(app.store, app.runtime, method,
                                        parent, name, label=label,
                                        image_root=app.cfg.image_root,
-                                       **kwargs)
+                                       marker=marker, **kwargs)
                 app.store.finish(marker)
 
             app.jobs.submit(f"{method}_image", marker, run)

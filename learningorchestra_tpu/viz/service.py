@@ -34,6 +34,7 @@ def create_embedding_image(store: DatasetStore, runtime: MeshRuntime,
                            method: str, parent: str, image_name: str,
                            label: Optional[str] = None,
                            image_root: Optional[str] = None,
+                           marker: Optional[str] = None,
                            **embed_kwargs) -> str:
     """Embed ``parent``'s numeric matrix with tsne|pca and save the PNG.
 
@@ -70,7 +71,8 @@ def create_embedding_image(store: DatasetStore, runtime: MeshRuntime,
             "label": label, "n_rows": int(len(X)),
             "state": spmd.jsonable_state(state),
             "feature_fields": list(feature_fields),
-            "embed_kwargs": embed_kwargs}):
+            "embed_kwargs": embed_kwargs},
+            outputs=(marker,) if marker else ()):
         emb = embed()
     labels = None
     if label is not None:

@@ -1,0 +1,117 @@
+"""Chaos child for the pod fault-injection test (tests/test_multiprocess.py).
+
+A worker process dies AFTER acking 'ready' and receiving 'go' — the
+mid-collective window that used to wedge the pod silently
+(parallel/spmd.py watchdog, VERDICT r4 #4). Process 0 must:
+  1. record a pollable ``error`` on the job's output dataset, and
+  2. fail later dispatches FAST (degraded pod), not after a 60s timeout.
+
+Run as: python tests/chaos_child.py <process_id> <num_processes>
+<coord_port> <shared_root>.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+pid, nprocs, port, root = (int(sys.argv[1]), int(sys.argv[2]),
+                           int(sys.argv[3]), sys.argv[4])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+os.environ["LO_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nprocs, process_id=pid)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: E402
+from learningorchestra_tpu.config import Settings  # noqa: E402
+from learningorchestra_tpu.parallel import spmd  # noqa: E402
+from learningorchestra_tpu.parallel.mesh import MeshRuntime  # noqa: E402
+
+cfg = Settings()
+cfg.store_root = os.path.join(root, "store")
+cfg.persist = True
+store = DatasetStore(cfg)
+runtime = MeshRuntime(cfg)
+
+
+def make_split(seed, n):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    y = (a > 0).astype(np.int64)
+    return {"a": a, "label": y}
+
+
+if pid == 0:
+    from learningorchestra_tpu.models.builder import ModelBuilder
+
+    store.create("c_train", columns=make_split(0, 2000), finished=True)
+    store.create("c_test", columns=make_split(1, 500), finished=True)
+    mb = ModelBuilder(store, runtime, cfg)
+    build_state = {}
+
+    def run_build():
+        # May wedge forever in a collective once the worker dies — that
+        # is the failure mode under test; the watchdog's job is to make
+        # the FAILURE visible even while this thread is stuck.
+        try:
+            mb.build("c_train", "c_test", "c_pred", ["lr"], "label")
+            build_state["status"] = "returned"
+        except Exception as exc:  # noqa: BLE001
+            build_state["status"] = f"raised:{type(exc).__name__}"
+
+    threading.Thread(target=run_build, daemon=True).start()
+
+    out = {"error": None}
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            doc = store.read("c_pred_lr", limit=1)[0]
+            if doc.get("finished") and doc.get("error"):
+                out["error"] = doc["error"]
+                break
+        except Exception:  # noqa: BLE001 — dataset not created yet
+            pass
+        time.sleep(0.2)
+
+    # The pod is now permanently short a worker: the next dispatch must
+    # refuse immediately with the degradation reason.
+    store.create("c_h", columns={"v": (np.arange(100) % 3).astype(np.int64)},
+                 finished=True)
+    t0 = time.time()
+    try:
+        from learningorchestra_tpu.ops.histogram import create_histogram
+
+        create_histogram(store, runtime, "c_h", "c_hist", ["v"])
+        out["second_job"] = "ran"
+    except RuntimeError as exc:
+        out["second_job"] = f"refused: {exc}"
+    out["second_job_s"] = time.time() - t0
+    out["build_thread"] = build_state.get("status", "wedged")
+    with open(os.path.join(root, "chaos.json"), "w") as f:
+        json.dump(out, f)
+    # The build thread may be wedged in a dead collective — exiting
+    # through it is the supervisor's job (run_pod.sh restarts the pod).
+    os._exit(0)
+else:
+    # Fault injection: prep normally (realistic 'ready' ack), then die at
+    # the first device op after 'go'.
+    real_prepper = spmd._PREPPERS["build"]
+
+    def dying_prepper(store_, runtime_, spec):
+        real_prepper(store_, runtime_, spec)
+        return lambda: os._exit(42)
+
+    spmd._PREPPERS["build"] = dying_prepper
+    spmd.worker_loop(store, runtime)

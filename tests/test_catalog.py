@@ -262,6 +262,40 @@ def test_value_counts_nulls(store):
     assert store.value_counts("n", "x") == {1.0: 2, 2.0: 1, None: 1}
 
 
+def test_replica_failover_restores_catalog(tmp_path):
+    """VERDICT r4 #4: losing the primary store_root entirely must be
+    recoverable from the replica mirror (the reference's Mongo
+    primary/secondary failover, docker-compose.yml:49-91)."""
+    import shutil
+
+    from learningorchestra_tpu.config import Settings
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "primary")
+    cfg.replica_root = str(tmp_path / "replica")
+    cfg.persist = True
+    store = DatasetStore(cfg)
+    store.create("r1", columns={"a": np.arange(100),
+                                "s": np.array(["x", "y"] * 50,
+                                              dtype=object)})
+    store.finish("r1", note="ok")
+    store.create("r2", columns={"b": np.arange(7)})
+    store.finish("r2")
+
+    shutil.rmtree(cfg.store_root)          # simulated primary loss
+
+    store2 = DatasetStore(cfg)
+    names = store2.load_all()
+    assert set(names) >= {"r1", "r2"}
+    ds = store2.get("r1")
+    assert ds.num_rows == 100
+    assert list(ds.column("a")[:3]) == [0, 1, 2]
+    assert ds.column("s")[1] == "y"
+    assert ds.metadata.finished is True
+    assert ds.metadata.extra["note"] == "ok"
+    assert store2.get("r2").num_rows == 7
+
+
 def test_read_pagination_skip_past_metadata(store):
     import numpy as np
     store.create("p", columns={"a": np.arange(5)}, finished=True)

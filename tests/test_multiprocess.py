@@ -20,6 +20,8 @@ import pytest
 
 _CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "spmd_child.py")
+_CHAOS_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "chaos_child.py")
 
 
 def _free_port() -> int:
@@ -74,3 +76,41 @@ def test_two_process_model_build(tmp_path):
         str(v): (546 if v < 5 else 545) for v in range(11)}, result
     # Undispatched mesh ops refuse cleanly on a pod.
     assert result["guard"].startswith("refused"), result
+
+
+def test_worker_death_mid_job_fails_pollably(tmp_path):
+    """VERDICT r4 #4: a worker dying AFTER 'go' (the mid-collective
+    window) must surface as a recorded, pollable job failure on process 0
+    — not a silent pod wedge — and later dispatches must refuse fast."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _CHAOS_CHILD, str(i), "2", str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("chaos pod deadlocked (the wedge the watchdog must "
+                    "prevent):\n" + "\n---\n".join(o or "" for o in outs))
+    assert procs[0].returncode == 0, f"process 0 failed:\n{outs[0]}"
+    assert procs[1].returncode == 42, "worker should have died by design"
+
+    with open(tmp_path / "chaos.json") as f:
+        result = json.load(f)
+    # The job's output dataset carries a pollable error.
+    assert result["error"], result
+    # The degraded pod refuses the next dispatch immediately.
+    assert result["second_job"].startswith("refused"), result
+    assert "degraded" in result["second_job"], result
+    assert result["second_job_s"] < 10.0, result

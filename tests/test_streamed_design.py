@@ -97,6 +97,59 @@ def test_read_rows_touches_only_overlapping_chunks(cfg):
     assert len(loads) <= 2
 
 
+def test_paginated_read_on_spilled_dataset_touches_O1_chunks(cfg):
+    """VERDICT r4 #3: GET /files/x?skip&limit on a spilled dataset must
+    read O(page) chunks, never consolidate. Filtered reads early-out once
+    the page is filled."""
+    cfg.persist = True
+    cfg.ram_budget_mb = 1
+    store = DatasetStore(cfg)
+    ds = _fill_ds(store, "pg", n=40_000, chunk=2000, seed=6)
+    assert ds.over_budget
+
+    from learningorchestra_tpu.catalog import dataset as dsmod
+
+    def counting(fn):
+        loads = []
+        orig = dsmod._Chunk.materialize
+
+        def spy(self, fields=None):
+            loads.append(self)
+            return orig(self, fields)
+
+        dsmod._Chunk.materialize = spy
+        try:
+            out = fn()
+        finally:
+            dsmod._Chunk.materialize = orig
+        return out, len(loads)
+
+    docs, n_loads = counting(lambda: store.read("pg", skip=0, limit=10))
+    assert docs[0]["_id"] == 0 and len(docs) == 10   # metadata + 9 rows
+    assert docs[1]["_id"] == 1 and docs[9]["_id"] == 9
+    assert n_loads <= 2
+
+    # deep page: only the chunks overlapping rows 30_000..30_010
+    docs, n_loads = counting(
+        lambda: store.read("pg", skip=30_001, limit=10))
+    assert [d["_id"] for d in docs] == list(range(30_001, 30_011))
+    assert n_loads <= 2
+
+    # filtered read satisfied by the first block early-outs
+    docs, n_loads = counting(
+        lambda: store.read("pg", skip=0, limit=5,
+                           query={"_id": {"$lte": 100}}))
+    assert len(docs) == 5
+    assert n_loads <= 40   # one 64k block of 2k-row chunks, not all 20
+
+    # filtered read agrees with the resident evaluation
+    docs = store.read("pg", skip=0, limit=3, query={"cat": "b"})
+    assert all(d["cat"] == "b" for d in docs)
+    full = ds.columns          # resident comparison (consolidates; test rig)
+    expect_ids = (np.nonzero(full["cat"] == "b")[0] + 1)[:3]
+    assert [d["_id"] for d in docs] == list(expect_ids)
+
+
 def test_streamed_state_and_matrix_match_resident(store):
     ds = _fill_ds(store, "eq", n=3000, chunk=256)
     steps = [{"op": "label_encode"},
