@@ -12,6 +12,24 @@
 # (shared filesystem) — it is the data plane workers rebuild job inputs
 # from, the role MongoDB played for the reference's Spark executors.
 #
+# Elastic recovery: each host's process runs under the pod SUPERVISOR
+# (learningorchestra_tpu/supervisor.py — the restart_policy:on-failure
+# analogue of the reference's docker-compose.yml:14-15). On a process
+# death or a degraded /cluster report, the supervisor restarts the pod
+# processes under a NEW MESH EPOCH (LO_TPU_MESH_EPOCH) with bounded
+# exponential backoff (LO_TPU_RESTART_BACKOFF_S, doubling up to
+# LO_TPU_RESTART_BACKOFF_MAX_S) and a restart budget
+# (LO_TPU_RESTART_BUDGET); stale-epoch workers are rejected at the job
+# channel handshake. Across hosts the epoch agrees via a file on the
+# shared store root (<LO_TPU_STORE_ROOT>/.mesh_epoch): host 0's
+# supervisor owns/increments it, worker hosts' supervisors follow it
+# (a change restarts their children at the new epoch, budget-free). The restarted process 0 automatically re-runs jobs
+# whose outputs failed with a `pod failure:` / `interrupted:` error, up
+# to LO_TPU_JOB_RETRIES times. Past the budget, the supervisor serves
+# the failure reason on /cluster instead of going dark. Set SUPERVISE=0
+# to run the bare server (the pre-supervisor behavior). See
+# docs/fault_tolerance.md for the full lifecycle.
+#
 # Usage:
 #   deploy/run_pod.sh                      # single host, all local chips
 #   COORDINATOR=host0:8476 NUM_HOSTS=4 HOST_ID=2 deploy/run_pod.sh
@@ -39,4 +57,18 @@ if [[ -n "${COORDINATOR:-}" ]]; then
 fi
 
 make -C native >/dev/null 2>&1 || true   # native CSV parser (optional)
-exec python -m learningorchestra_tpu.serving --host 0.0.0.0 --port "$PORT"
+
+if [[ "${SUPERVISE:-1}" != "1" ]]; then
+  exec python -m learningorchestra_tpu.serving --host 0.0.0.0 --port "$PORT"
+fi
+
+SUP_ARGS=()
+if [[ "${LO_TPU_PROCESS_ID:-0}" == "0" ]]; then
+  # Host 0 polls its own /cluster for degradation (a remote worker death
+  # poisons the pod without killing any local process) and keeps the
+  # port answering with the failure reason if the restart budget runs out.
+  SUP_ARGS=(--health-url "http://127.0.0.1:${PORT}/cluster"
+            --fallback-port "$PORT")
+fi
+exec python -m learningorchestra_tpu.supervisor "${SUP_ARGS[@]}" -- \
+  python -m learningorchestra_tpu.serving --host 0.0.0.0 --port "$PORT"

@@ -37,6 +37,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -766,10 +767,7 @@ class Dataset:
                 cols = c.materialize(fields)
                 yield {f: coerce(f, a) for f, a in cols.items()}
         finally:
-            with self._data_lock:
-                self._active_readers -= 1
-                if self._pending_gc and not self._active_readers:
-                    self._gc_locked()
+            self._release_reader()
 
     @staticmethod
     def _make_coercer(chunks, want):
@@ -827,10 +825,30 @@ class Dataset:
         try:
             yield SnapshotReader(self, chunks)
         finally:
-            with self._data_lock:
-                self._active_readers -= 1
-                if self._pending_gc and not self._active_readers:
-                    self._gc_locked()
+            self._release_reader()
+
+    def pin_snapshot(self) -> "SnapshotReader":
+        """Long-lived form of :meth:`snapshot` for readers whose lifetime
+        doesn't fit a ``with`` block — a :class:`~learningorchestra_tpu.
+        ops.preprocess.ChunkedDesign` reads row ranges lazily for as long
+        as a build holds it, and every one of those reads must see the
+        same chunk generation (a concurrent ``set_column`` rewrite must
+        never mix pre-/post-rewrite rows across fitting passes or device
+        shards). The active-reader registration is released when the
+        returned reader is garbage-collected, or eagerly via its
+        ``release()``."""
+        with self._data_lock:
+            chunks = list(self._chunks)
+            self._active_readers += 1
+        reader = SnapshotReader(self, chunks)
+        reader._finalizer = weakref.finalize(reader, self._release_reader)
+        return reader
+
+    def _release_reader(self) -> None:
+        with self._data_lock:
+            self._active_readers -= 1
+            if self._pending_gc and not self._active_readers:
+                self._gc_locked()
 
     def read_rows(self, fields: Optional[List[str]] = None,
                   start: int = 0, stop: Optional[int] = None,
@@ -1105,6 +1123,15 @@ class SnapshotReader:
         self._chunks = chunks
         self.n_rows = sum(c.n_rows for c in chunks)
         self._coercers: Dict[Any, Any] = {}
+        #: Set by Dataset.pin_snapshot; context-managed snapshots release
+        #: through their ``with`` block instead.
+        self._finalizer = None
+
+    def release(self) -> None:
+        """Eagerly release a pinned snapshot (``Dataset.pin_snapshot``);
+        idempotent, and a no-op for context-managed snapshots."""
+        if self._finalizer is not None:
+            self._finalizer()
 
     def _coercer(self, fields: Optional[List[str]]):
         key = None if fields is None else tuple(fields)

@@ -45,6 +45,10 @@ class DatasetExists(ValueError):
     pass
 
 
+class DatasetFailed(RuntimeError):
+    """``finish`` refused: the dataset already carries a failure record."""
+
+
 #: Dataset names become directory names under store_root and arrive from the
 #: REST API, so they must never traverse paths.
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.\-]*$")
@@ -207,8 +211,19 @@ class DatasetStore:
 
     def finish(self, name: str, **extra) -> None:
         """Flip ``finished`` true and persist — the commit point
-        (reference database.py:177-181, projection.py:113-123)."""
+        (reference database.py:177-181, projection.py:113-123).
+
+        A dataset already marked FAILED refuses to flip to success: the
+        pod watchdog fails a job's outputs the moment a worker dies
+        mid-job, and the surviving process's compute may still run to
+        completion afterwards (death after the worker's last collective)
+        — its late ``finish`` must not overwrite the recorded failure
+        with a half-a-pod success."""
         ds = self.get(name)
+        if ds.metadata.finished and ds.metadata.error:
+            raise DatasetFailed(
+                f"dataset {name} is already marked failed "
+                f"({ds.metadata.error}); refusing to mark it finished")
         ds.metadata.extra.update(extra)
         ds.metadata.finished = True
         if self.cfg.persist:
@@ -216,12 +231,48 @@ class DatasetStore:
 
     def fail(self, name: str, error: str) -> None:
         """Record job failure so pollers don't spin forever (fixes the
-        reference's finished:false-forever failure mode, SURVEY.md §5)."""
+        reference's finished:false-forever failure mode, SURVEY.md §5).
+
+        First failure wins: a dataset already in a terminal state keeps
+        its original record — the root cause (e.g. the watchdog's ``pod
+        failure:`` flag, which the retry rescan keys on) must not be
+        overwritten by downstream errors cascading from it."""
         ds = self.get(name)
+        if ds.metadata.finished:
+            return
         ds.metadata.error = error
         ds.metadata.finished = True
         if self.cfg.persist:
             self.save(name)
+
+    def reopen(self, name: str) -> Dataset:
+        """Reset a failed dataset for an automatic re-run (the job-retry
+        path, serving/app.py): clear the failure record, drop any
+        partially-written rows (a re-run appending after a partial save
+        would duplicate them), and count the attempt in ``retries``. The
+        journaled chunk store makes this safe — the replaced incarnation's
+        chunk files are simply never referenced again."""
+        ds = self.get(name)
+        meta = ds.metadata
+        meta.error = None
+        meta.finished = False
+        meta.fields = []
+        meta.extra["retries"] = int(meta.extra.get("retries", 0) or 0) + 1
+        fresh = Dataset(meta)
+        path = self._path(name)
+        shutil.rmtree(os.path.join(path, "chunks"), ignore_errors=True)
+        for fn in ("journal.jsonl", "data.parquet"):
+            try:
+                os.remove(os.path.join(path, fn))
+            except FileNotFoundError:
+                pass
+        self._attach_storage(fresh)
+        with self._lock:
+            self._datasets[name] = fresh
+            self._mirror_state.pop(name, None)
+        if self.cfg.persist:
+            self.save(name)
+        return fresh
 
     # -- reads ---------------------------------------------------------------
 
@@ -272,7 +323,8 @@ class DatasetStore:
             # dataset never decompresses the other columns of
             # non-matching blocks.
             to_skip = row_skip
-            for off, n_blk, block in snap.scan(_query_fields(query, fields)):
+            for off, n_blk, block in snap.scan(_query_fields(query, fields),
+                                               block_rows=_READ_BLOCK_ROWS):
                 idx = self._query_indices(block, fields, query,
                                           id_offset=off, n=n_blk)
                 if to_skip:

@@ -40,6 +40,12 @@ class Context:
     Connection errors on idempotent calls (GET/DELETE) retry with
     exponential backoff; POSTs never auto-retry (a retried create whose
     first attempt actually landed would surface as a spurious 409).
+
+    A 503 answer (the pod is degraded; its supervisor is restarting it
+    under a new mesh epoch) retries idempotent calls too, honoring the
+    server's ``Retry-After`` hint — a pod mid-recovery looks like a slow
+    request, not an error, exactly as a Swarm-restarted reference service
+    would.
     """
 
     def __init__(self, base_url: str, poll_seconds: float =
@@ -63,13 +69,25 @@ class Context:
         attempt = 0
         while True:
             try:
-                return requests.request(method, self.url(path),
+                resp = requests.request(method, self.url(path),
                                         timeout=deadline, **kwargs)
             except requests.ConnectionError:
                 if attempt >= retries:
                     raise
                 time.sleep(self.backoff_seconds * (2 ** attempt))
                 attempt += 1
+                continue
+            if resp.status_code == 503 and attempt < retries:
+                # Pod mid-recovery (supervisor restart): honor the
+                # server's backoff hint and retry.
+                try:
+                    wait = float(resp.headers.get("Retry-After", ""))
+                except ValueError:
+                    wait = self.backoff_seconds * (2 ** attempt)
+                time.sleep(wait)
+                attempt += 1
+                continue
+            return resp
 
     def get(self, path: str, **kw):
         return self.request("GET", path, **kw)

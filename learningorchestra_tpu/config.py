@@ -158,6 +158,36 @@ class Settings:
         default_factory=lambda: _env("LO_TPU_PERSIST_MODELS", True, bool)
     )
 
+    # --- elastic recovery (supervisor.py) ----------------------------------
+    #: Automatic re-runs per job whose outputs failed from INFRASTRUCTURE
+    #: (``pod failure:`` watchdog flags, ``interrupted:`` restart marks) —
+    #: the analogue of Spark re-running lost tasks on recovered executors.
+    #: On startup, process 0 rescans the store and resubmits such jobs
+    #: until each has been retried this many times. 0 disables retry.
+    job_retries: int = field(
+        default_factory=lambda: _env("LO_TPU_JOB_RETRIES", 1)
+    )
+    #: Pod restarts the supervisor will attempt before declaring the pod
+    #: failed (reason then served via its fallback /cluster responder) —
+    #: the bounded analogue of the reference's restart_policy:on-failure.
+    restart_budget: int = field(
+        default_factory=lambda: _env("LO_TPU_RESTART_BUDGET", 5)
+    )
+    #: First restart delay, seconds; doubles per restart (exponential
+    #: backoff) up to ``restart_backoff_max_s``.
+    restart_backoff_s: float = field(
+        default_factory=lambda: _env("LO_TPU_RESTART_BACKOFF_S", 1.0)
+    )
+    restart_backoff_max_s: float = field(
+        default_factory=lambda: _env("LO_TPU_RESTART_BACKOFF_MAX_S", 30.0)
+    )
+    #: Cadence of the supervisor's /cluster health poll, seconds — catches
+    #: degradations where no supervised process died (e.g. a remote host's
+    #: worker vanished and the watchdog poisoned this pod).
+    health_interval_s: float = field(
+        default_factory=lambda: _env("LO_TPU_HEALTH_INTERVAL_S", 2.0)
+    )
+
     # --- observability -----------------------------------------------------
     #: When set, compute jobs run under jax.profiler.trace writing
     #: TensorBoard-loadable device traces here.

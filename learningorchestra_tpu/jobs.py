@@ -15,6 +15,7 @@ bounded worker pool replacing unbounded daemon-thread spawning.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 import traceback
@@ -23,6 +24,44 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from learningorchestra_tpu.utils.profiling import op_timer
+
+#: Error prefixes marking a job killed by INFRASTRUCTURE — a pod worker
+#: death (watchdog flag, parallel/spmd.py) or a process restart mid-job
+#: (catalog load_all) — rather than by its own inputs. Only these are
+#: safe and useful to retry automatically: a deterministic input error
+#: would just fail identically again.
+RETRYABLE_ERROR_PREFIXES = ("pod failure:", "interrupted:")
+
+
+def select_retry_groups(docs: List[Dict[str, Any]],
+                        max_retries: int) -> List[Dict[str, Any]]:
+    """Pick the failed jobs worth re-running after a restart.
+
+    ``docs`` are catalog metadata docs (``DatasetStore.metadata_docs``).
+    A dataset is retryable when it reached a terminal FAILED state from an
+    infrastructure cause (:data:`RETRYABLE_ERROR_PREFIXES`), carries the
+    ``job`` spec the serving layer recorded at submission (enough to
+    re-run it), and has been retried fewer than ``max_retries`` times.
+    Datasets sharing one job spec (a model build owns one prediction
+    dataset per classifier) group into a single re-run. Returns
+    ``[{"spec": job_spec, "datasets": [names...]}, ...]``.
+    """
+    groups: Dict[str, Dict[str, Any]] = {}
+    for doc in docs:
+        err = doc.get("error")
+        if not doc.get("finished") or not err:
+            continue
+        if not any(err.startswith(p) for p in RETRYABLE_ERROR_PREFIXES):
+            continue
+        spec = doc.get("job")
+        if not isinstance(spec, dict) or "kind" not in spec:
+            continue
+        if int(doc.get("retries", 0) or 0) >= max_retries:
+            continue
+        key = json.dumps(spec, sort_keys=True, default=str)
+        group = groups.setdefault(key, {"spec": spec, "datasets": []})
+        group["datasets"].append(doc["filename"])
+    return list(groups.values())
 
 
 @dataclass
@@ -83,22 +122,37 @@ class JobManager:
                     if r.status != "running":
                         del self._jobs[jid]
 
+        def _fail_datasets():
+            for name in datasets:
+                # Only unfinished datasets get the failure flag — ones
+                # that completed before the crash keep their results.
+                try:
+                    if not self.store.get(name).metadata.finished:
+                        self.store.fail(name, rec.error)
+                except Exception:
+                    pass
+
         def run():
+            from learningorchestra_tpu.parallel.spmd import PodDegraded
+
             try:
                 fn()
                 rec.status = "done"
+            except PodDegraded as exc:
+                # A job refused (or interrupted) because the pod is
+                # degraded failed from INFRASTRUCTURE, exactly like one
+                # the watchdog flagged — record it under the retryable
+                # prefix so the restarted pod's rescan re-runs it, e.g.
+                # a build queued behind the one whose worker died.
+                rec.status = "failed"
+                rec.error = f"pod failure: {exc}"
+                traceback.print_exc()
+                _fail_datasets()
             except Exception as exc:  # noqa: BLE001 — job boundary
                 rec.status = "failed"
                 rec.error = f"{type(exc).__name__}: {exc}"
                 traceback.print_exc()
-                for name in datasets:
-                    # Only unfinished datasets get the failure flag — ones
-                    # that completed before the crash keep their results.
-                    try:
-                        if not self.store.get(name).metadata.finished:
-                            self.store.fail(name, rec.error)
-                    except Exception:
-                        pass
+                _fail_datasets()
             finally:
                 rec.finished_at = time.time()
                 op_timer.record(f"job.{kind}",

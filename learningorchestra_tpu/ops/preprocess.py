@@ -191,16 +191,17 @@ _DEFAULT_STEPS = ({"op": "label_encode"}, {"op": "fillna", "strategy": "mean"})
 _FIT_BLOCK_ROWS = 1 << 18
 
 
-def _iter_blocks(ds: Dataset, n_rows: int, fields=None):
-    """Stream the pinned row prefix ``[0, n_rows)`` chunk-by-chunk (the
-    final chunk trimmed), with iter_chunks' unified dtypes."""
+def _iter_blocks(snap, n_rows: int, fields=None):
+    """Stream the pinned row prefix ``[0, n_rows)`` in bounded blocks over
+    ONE chunk snapshot (``Dataset.snapshot``/``pin_snapshot`` reader) with
+    consolidation's unified dtypes. Reading every fitting pass through the
+    same snapshot is what makes a concurrent ``set_column`` rewrite
+    invisible to an in-flight streamed build — each pass would otherwise
+    open its own chunk view and could mix pre-/post-rewrite rows."""
     got = 0
     if n_rows <= 0:
         return
-    for cols in ds.iter_chunks(fields):
-        if not cols:
-            continue
-        k = len(next(iter(cols.values())))
+    for _off, k, cols in snap.scan(fields, block_rows=_FIT_BLOCK_ROWS):
         if got + k > n_rows:
             take = n_rows - got
             cols = {f: a[:take] for f, a in cols.items()}
@@ -212,11 +213,11 @@ def _iter_blocks(ds: Dataset, n_rows: int, fields=None):
             return
 
 
-def _apply_prefix_blocks(ds: Dataset, n_rows: int, label: str,
+def _apply_prefix_blocks(snap, n_rows: int, label: str,
                          prefix_steps, state):
     """Stream blocks with the (already fully fitted) step prefix applied —
     what the next fitting step's statistics are computed over."""
-    for cols in _iter_blocks(ds, n_rows):
+    for cols in _iter_blocks(snap, n_rows):
         cols.pop(label, None)
         out, _ = apply_steps(cols, prefix_steps, state)
         yield out
@@ -235,17 +236,18 @@ def _encode_label_block(lab: np.ndarray, state: Dict) -> np.ndarray:
     return y.astype(np.int32)
 
 
-def _fit_label_vocab(ds: Dataset, label: str, n_rows: int) -> Dict[str, int]:
+def _fit_label_vocab(snap, label: str, n_rows: int) -> Dict[str, int]:
     """Streaming label-vocab fit: sorted distinct keyed values — exactly
     ``_label_encode``'s np.unique order over the full column."""
     uniq: set = set()
-    for cols in _iter_blocks(ds, n_rows, [label]):
+    for cols in _iter_blocks(snap, n_rows, [label]):
         uniq.update("\0none" if v is None else str(v) for v in cols[label])
     return {v: i for i, v in enumerate(sorted(uniq))}
 
 
-def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
-    """Streaming-fit all pipeline statistics; returns the fitted state.
+def _fit_design_state(snap, fields, label: str, steps, n_rows: int) -> Dict:
+    """Streaming-fit all pipeline statistics over ONE pinned chunk
+    snapshot; returns the fitted state.
 
     Semantics match the resident fit per step: label vocab = sorted
     distinct keyed values (np.unique's order), fillna means = nanmean,
@@ -253,10 +255,10 @@ def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
     two-pass form the resident path uses — the one-pass E[x²]−E[x]² form
     catastrophically cancels, see models/logistic._device_stats)."""
     state: Dict[str, Any] = {}
-    if label in ds.metadata.fields and n_rows:
-        probe = ds.read_rows([label], 0, 1)[label]
+    if label in fields and n_rows:
+        probe = snap.read([label], 0, 1)[label]
         if probe.dtype == object:
-            state["__label_vocab__"] = _fit_label_vocab(ds, label, n_rows)
+            state["__label_vocab__"] = _fit_label_vocab(snap, label, n_rows)
     for i, step in enumerate(steps):
         op = step.get("op")
         key = f"{i}:{op}"
@@ -264,7 +266,7 @@ def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
         if op == "label_encode":
             want = set(step.get("fields") or ())
             vocab_sets: Dict[str, set] = {}
-            for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+            for cols in _apply_prefix_blocks(snap, n_rows, label, prefix,
                                              state):
                 for f, c in cols.items():
                     if c.dtype == object and (not want or f in want):
@@ -277,7 +279,7 @@ def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
             if strategy == "mean":
                 sums: Dict[str, float] = {}
                 cnts: Dict[str, int] = {}
-                for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+                for cols in _apply_prefix_blocks(snap, n_rows, label, prefix,
                                                  state):
                     for f, c in cols.items():
                         if c.dtype.kind != "f":
@@ -291,7 +293,7 @@ def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
             elif strategy in ("zero", "value"):
                 val = 0.0 if strategy == "zero" else step["value"]
                 fill = {}
-                for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+                for cols in _apply_prefix_blocks(snap, n_rows, label, prefix,
                                                  state):
                     fill.update({f: val for f, c in cols.items()
                                  if c.dtype.kind == "f" and f not in fill})
@@ -302,7 +304,7 @@ def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
                     f"unknown fillna strategy {strategy!r}")
         elif op == "standardize":
             sums, cnts = {}, {}
-            for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+            for cols in _apply_prefix_blocks(snap, n_rows, label, prefix,
                                              state):
                 for f, c in cols.items():
                     if c.dtype.kind not in "if":
@@ -313,7 +315,7 @@ def _fit_design_state(ds: Dataset, label: str, steps, n_rows: int) -> Dict:
                     cnts[f] = cnts.get(f, 0) + int(fin.sum())
             mus = {f: (sums[f] / cnts[f] if cnts[f] else 0.0) for f in sums}
             sq = {f: 0.0 for f in sums}
-            for cols in _apply_prefix_blocks(ds, n_rows, label, prefix,
+            for cols in _apply_prefix_blocks(snap, n_rows, label, prefix,
                                              state):
                 for f, c in cols.items():
                     if f not in sq:
@@ -342,18 +344,23 @@ class ChunkedDesign:
     store — quacks enough like an ndarray (shape/len/dtype) for the
     trainer surface while materializing rows only on demand.
 
-    ``rows(start, stop)`` reads just the chunks overlapping the range
-    (Dataset.read_rows) and applies the FITTED pipeline, which is row-local
-    by construction. ``MeshRuntime.shard_rows`` recognizes this type and
-    builds each device shard from exactly its own row range, so a pod
-    process's peak host memory is its local shard — the reference's
-    executor data residency (model_builder.py:200) rather than N copies of
-    the full matrix. Treat as immutable: it pins ``n_rows`` so appends
-    after construction never shift its rows."""
+    ``rows(start, stop)`` reads just the chunks overlapping the range and
+    applies the FITTED pipeline, which is row-local by construction.
+    ``MeshRuntime.shard_rows`` recognizes this type and builds each device
+    shard from exactly its own row range, so a pod process's peak host
+    memory is its local shard — the reference's executor data residency
+    (model_builder.py:200) rather than N copies of the full matrix. Treat
+    as immutable: it holds ONE pinned chunk snapshot
+    (``Dataset.pin_snapshot``) for its whole lifetime, so appends never
+    shift its rows and a concurrent ``set_column`` generation rewrite can
+    never mix pre-/post-rewrite values across fitting passes or device
+    shards (every read — state fitting included — goes through the same
+    snapshot the matrix was defined over)."""
 
     def __init__(self, ds: Dataset, label: str, steps, state,
-                 feature_fields, n_rows: int):
+                 feature_fields, n_rows: int, snap=None):
         self.ds = ds
+        self._snap = snap if snap is not None else ds.pin_snapshot()
         self.label = label
         self.steps = [dict(s) for s in steps]
         self.state = state
@@ -379,7 +386,7 @@ class ChunkedDesign:
         stop = min(int(stop), self.shape[0])
         if not self.feature_fields:
             return np.zeros((max(stop - start, 0), 0), np.float32)
-        cols = self.ds.read_rows(self._input_fields, start, stop)
+        cols = self._snap.read(self._input_fields, start, stop)
         cols.pop(self.label, None)
         cols, _ = apply_steps(cols, self.steps, self.state)
         return np.stack([np.asarray(cols[f], np.float32)
@@ -411,33 +418,40 @@ def design_matrix_streamed(ds: Dataset, label: str,
     streaming passes; a provided state (the test set / SPMD-worker path)
     is applied as-is. ``n_rows`` pins the row snapshot (SPMD workers pin
     to the dispatched spec's counts). ``need_y=False`` (the predict
-    paths, which discard y) skips the label-column scan entirely."""
-    total = ds.num_rows
+    paths, which discard y) skips the label-column scan entirely.
+
+    Every read — fitting passes, label encode, feature-field sampling,
+    and the returned matrix's lazy row reads — goes through ONE pinned
+    chunk snapshot, held for the :class:`ChunkedDesign`'s lifetime."""
+    snap = ds.pin_snapshot()
+    total = snap.n_rows
     n_rows = total if n_rows is None else min(int(n_rows), total)
     steps = [dict(s) for s in steps] or [dict(s) for s in _DEFAULT_STEPS]
     if state is None:
-        state = _fit_design_state(ds, label, steps, n_rows)
+        state = _fit_design_state(snap, ds.metadata.fields, label, steps,
+                                  n_rows)
     else:
         state = dict(state)
     y = None
     if need_y and label in ds.metadata.fields:
         if (n_rows and "__label_vocab__" not in state
-                and ds.read_rows([label], 0, 1)[label].dtype == object):
+                and snap.read([label], 0, 1)[label].dtype == object):
             # Apply-with-given-state path on an object label whose vocab
             # was never fitted (possible only if the train set lacked the
             # label column): fit it here, as the resident path would.
-            state["__label_vocab__"] = _fit_label_vocab(ds, label, n_rows)
+            state["__label_vocab__"] = _fit_label_vocab(snap, label, n_rows)
         parts = [_encode_label_block(cols[label], state)
-                 for cols in _iter_blocks(ds, n_rows, [label])]
+                 for cols in _iter_blocks(snap, n_rows, [label])]
         y = (np.concatenate(parts) if parts
              else np.empty(0, dtype=np.int32))
     if feature_fields is None:
-        sample = ds.read_rows(None, 0, min(n_rows, 1024))
+        sample = snap.read(None, 0, min(n_rows, 1024))
         sample.pop(label, None)
         sampled, _ = apply_steps(sample, steps, state)
         feature_fields = [f for f in sampled
                           if sampled[f].dtype.kind in "ifub"]
-    X = ChunkedDesign(ds, label, steps, state, feature_fields, n_rows)
+    X = ChunkedDesign(ds, label, steps, state, feature_fields, n_rows,
+                      snap=snap)
     return X, y, list(feature_fields), state
 
 

@@ -53,6 +53,15 @@ def initialize(coordinator_address: Optional[str] = None,
         process_id = int(os.environ["LO_TPU_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
         return  # single-host
+    if "cpu" in (os.environ.get("JAX_PLATFORMS") or ""):
+        # Cross-process collectives on the CPU backend need an explicit
+        # implementation on older jax (0.4.x defaults to none, and every
+        # multi-process psum fails to compile). Best-effort: the option
+        # name may not exist on other versions.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — version-dependent option
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -43,10 +43,29 @@ from typing import Any, Dict, List, Optional
 log = logging.getLogger("lo_tpu.spmd")
 
 
+class PodDegraded(RuntimeError):
+    """The pod cannot run mesh jobs until its supervisor restarts it.
+    Mapped to HTTP 503 + Retry-After by the serving layer (a restarting
+    pod is a transient condition, not an internal error)."""
+
+
 def is_multiprocess() -> bool:
     import jax
 
     return jax.process_count() > 1
+
+
+def mesh_epoch() -> int:
+    """This incarnation's mesh generation. The supervisor
+    (learningorchestra_tpu/supervisor.py) bumps ``LO_TPU_MESH_EPOCH`` on
+    every pod restart; the job channel rejects workers whose epoch
+    differs at handshake, so a stale worker from a previous incarnation
+    can never join the new pod's collectives. Read dynamically (not
+    cached) so the poison scope below follows the env."""
+    try:
+        return int(os.environ.get("LO_TPU_MESH_EPOCH", "0") or 0)
+    except ValueError:
+        return 0
 
 
 def _job_addr() -> tuple:
@@ -55,6 +74,13 @@ def _job_addr() -> tuple:
     host, _, port = coord.rpartition(":")
     job_port = int(os.environ.get("LO_TPU_JOB_PORT", int(port) + 1))
     return host or "127.0.0.1", job_port
+
+
+def _close_quietly(sock: socket.socket) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 class _Conn:
@@ -96,7 +122,14 @@ class _JobChannel:
     before (or after) its workers. Dead connections are pruned on IO
     errors — a worker process cannot rejoin a running pod (its
     jax.distributed identity died with it), so the channel's job is to
-    fail *cleanly*, not to resync."""
+    fail *cleanly*, not to resync.
+
+    Every connection starts with an epoch handshake: the worker sends
+    ``{"op": "hello", "epoch": N}`` and is admitted only when N matches
+    this process's ``mesh_epoch()``. A worker from a previous pod
+    incarnation (stale epoch — e.g. one that outlived a supervisor
+    restart) is rejected and closed instead of occupying a worker slot
+    whose collectives it could never join correctly."""
 
     def __init__(self, n_workers: int):
         self.n_workers = n_workers
@@ -119,8 +152,55 @@ class _JobChannel:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                self._conns.append(_Conn(sock))
+            # Handshake off-thread: a half-open connection that never
+            # sends its hello must not block later workers from joining.
+            threading.Thread(target=self._handshake, args=(sock,),
+                             daemon=True, name="lo-spmd-handshake").start()
+
+    def _handshake(self, sock: socket.socket) -> None:
+        conn = _Conn(sock)
+        status, line = conn.recv_line(timeout=30.0)
+        if status != "ok":
+            _close_quietly(sock)
+            return
+        try:
+            hello = json.loads(line)
+        except json.JSONDecodeError:
+            hello = {}
+        epoch = mesh_epoch()
+        if hello.get("op") != "hello" or hello.get("epoch") != epoch:
+            log.warning(
+                "rejecting job-channel connection (epoch %r != pod epoch "
+                "%d): stale worker from a previous pod incarnation",
+                hello.get("epoch"), epoch)
+            try:
+                sock.sendall((json.dumps(
+                    {"op": "reject", "epoch": epoch,
+                     "reason": f"stale mesh epoch {hello.get('epoch')!r}; "
+                               f"pod is at epoch {epoch}"}) + "\n")
+                    .encode("utf-8"))
+            except OSError:
+                pass
+            _close_quietly(sock)
+            return
+        try:
+            sock.sendall((json.dumps({"op": "welcome", "epoch": epoch})
+                          + "\n").encode("utf-8"))
+        except OSError:
+            _close_quietly(sock)
+            return
+        with self._lock:
+            self._conns.append(conn)
+
+    def close(self) -> None:
+        """Tear down the listener and every worker connection (tests and
+        controlled shutdown)."""
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for conn in self._live():
+            self._drop(conn)
 
     def _live(self) -> List[_Conn]:
         with self._lock:
@@ -195,8 +275,7 @@ class _JobChannel:
                 # later dispatches refuse immediately instead of each
                 # burning the full connect timeout against a permanently
                 # short-handed pod (same rule as mid-job deaths).
-                global _pod_error
-                _pod_error = "worker died before ack"
+                _set_pod_error("worker died before ack")
             elif status == "timeout":
                 failures.append(
                     f"worker ack timed out after {prep_timeout_s:.0f}s")
@@ -240,11 +319,15 @@ class _JobChannel:
 _channel: Optional[_JobChannel] = None
 _channel_lock = threading.Lock()
 _dispatch_lock = threading.Lock()
-#: Set to a reason string when a worker died mid-job. A dead worker can
-#: never rejoin a running pod (its jax.distributed identity died with it),
-#: so once set every subsequent dispatch fails fast with this reason
-#: instead of timing out against a permanently short-handed pod.
-_pod_error: Optional[str] = None
+#: ``(mesh_epoch, reason)`` recorded when a worker died mid-job. A dead
+#: worker can never rejoin a *running* pod (its jax.distributed identity
+#: died with it), so once set every subsequent dispatch in the same
+#: incarnation fails fast with this reason instead of timing out against
+#: a permanently short-handed pod. The poison is EPOCH-SCOPED: a
+#: supervisor restart bumps the mesh epoch, and poison recorded under an
+#: earlier epoch no longer degrades the pod — the restarted incarnation
+#: serves again without any manual clearing.
+_pod_error: Optional[tuple] = None
 #: Thread-local mesh-job scope: set while this thread is allowed to enter
 #: mesh collectives on a multi-process pod (process 0 inside dispatch_guard,
 #: workers while executing a dispatched job's device ops).
@@ -304,27 +387,45 @@ def ensure_channel() -> None:
         _get_channel()
 
 
+def _set_pod_error(reason: str) -> None:
+    global _pod_error
+    _pod_error = (mesh_epoch(), reason)
+
+
 def pod_error() -> Optional[str]:
-    """The reason this pod is permanently degraded, or None while healthy."""
-    return _pod_error
+    """The reason this pod is degraded, or None while healthy. Poison
+    recorded under a previous mesh epoch is stale — the supervisor
+    restarted the pod since — and reads as healthy."""
+    if _pod_error is None:
+        return None
+    epoch, reason = _pod_error
+    return reason if epoch == mesh_epoch() else None
 
 
-def _check_pod_health() -> None:
-    if _pod_error is not None:
-        raise RuntimeError(
-            f"pod is degraded ({_pod_error}); a dead worker cannot rejoin "
-            "a running pod — restart the pod (deploy/run_pod.sh)")
+def require_pod_health() -> None:
+    """Raise :class:`PodDegraded` when this pod cannot run mesh jobs.
+    The serving layer calls this at the top of every dispatching route so
+    a degraded pod answers 503 + Retry-After (the supervisor is about to
+    restart it) instead of accepting jobs doomed to fail."""
+    reason = pod_error()
+    if reason is not None:
+        raise PodDegraded(
+            f"pod is degraded ({reason}); a dead worker cannot rejoin a "
+            "running pod — the supervisor will restart the pod under a "
+            "new mesh epoch (deploy/run_pod.sh)")
 
 
 def dispatch(spec: Dict[str, Any]) -> None:
     """Process-0 side: announce the next mesh job to every worker and
     rendezvous on their readiness. No-op single-process. Caller must then
     execute exactly the device-op sequence `run_job` executes for this
-    spec."""
+    spec. The spec is stamped with the pod's mesh epoch — workers nack
+    specs from a different incarnation (defense in depth behind the
+    connection handshake)."""
     if not is_multiprocess():
         return
-    _check_pod_health()
-    _get_channel().dispatch(spec)
+    require_pod_health()
+    _get_channel().dispatch(dict(spec, epoch=mesh_epoch()))
 
 
 @contextlib.contextmanager
@@ -353,7 +454,7 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
         raise RuntimeError(
             f"multi-process {op} jobs require a persisted shared store "
             "(LO_TPU_PERSIST=1 on a shared store_root)")
-    _check_pod_health()
+    require_pod_health()
     for name in inputs:
         store.save(name)
     with dispatch_guard():
@@ -361,8 +462,7 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
         stop = threading.Event()
 
         def on_death(reason: str) -> None:
-            global _pod_error
-            _pod_error = reason
+            _set_pod_error(reason)
             log.error("pod degraded: %s — failing job outputs %s",
                       reason, list(outputs))
             for name in outputs:
@@ -384,7 +484,7 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
         # worker died (death after its last collective): the outputs were
         # already flagged failed, so surface the degradation to the caller
         # rather than silently persisting half-a-pod's results.
-        _check_pod_health()
+        require_pod_health()
 
 
 class dispatch_guard:
@@ -606,16 +706,22 @@ def _connect_to_controller(timeout_s: float = 120.0) -> socket.socket:
             time.sleep(0.2)
 
 
-def worker_loop(store, runtime) -> None:
+def worker_loop(store, runtime) -> str:
     """Non-zero processes: block on the next job spec, prep host-side
     inputs, ack readiness, await ``go``, execute the device ops; repeat
     until shutdown. The store must point at the same (shared) store_root
     process 0 persists into — the data plane that replaces the reference's
-    Mongo-as-shared-storage for Spark executors."""
+    Mongo-as-shared-storage for Spark executors.
+
+    Returns the exit reason — ``"shutdown"`` (controlled, exit 0) vs
+    ``"controller-lost"`` / ``"stale-epoch"`` (this incarnation cannot
+    continue; the caller should exit nonzero so the host's supervisor
+    restarts the process into the pod's next incarnation)."""
     import jax
 
-    log.info("worker %d/%d entering SPMD loop",
-             jax.process_index(), jax.process_count())
+    epoch = mesh_epoch()
+    log.info("worker %d/%d entering SPMD loop (epoch %d)",
+             jax.process_index(), jax.process_count(), epoch)
     sock = _connect_to_controller()
     conn = _Conn(sock)
 
@@ -629,17 +735,34 @@ def worker_loop(store, runtime) -> None:
         except OSError:
             return False
 
+    # Epoch handshake: identify this incarnation before taking a worker
+    # slot; the controller rejects a stale epoch (supervisor restarted the
+    # pod since this process started).
+    if not reply({"op": "hello", "epoch": epoch,
+                  "process": jax.process_index()}):
+        log.info("controller lost during handshake; exiting")
+        return "controller-lost"
+    status, line = conn.recv_line(60.0)
+    if status != "ok":
+        log.info("controller lost during handshake; exiting")
+        return "controller-lost"
+    verdict = json.loads(line)
+    if verdict.get("op") != "welcome":
+        log.warning("controller rejected this worker: %s",
+                    verdict.get("reason", verdict))
+        return "stale-epoch"
+
     while True:
         status, line = conn.recv_line(None)
         if status != "ok":
             log.info("controller closed the job channel; exiting")
-            return
+            return "controller-lost"
         spec = json.loads(line)
         op = spec.get("op")
         rnd = spec.get("round")
         if op == "shutdown":
             log.info("worker %d shutting down", jax.process_index())
-            return
+            return "shutdown"
         if op in ("go", "abort"):
             continue  # stray control line from an aborted round
         prepper = _PREPPERS.get(op)
@@ -647,6 +770,12 @@ def worker_loop(store, runtime) -> None:
         if prepper is None:
             ok = reply({"status": "fail", "round": rnd,
                         "error": f"unknown job op: {op!r}"})
+        elif spec.get("epoch") not in (None, epoch):
+            # Defense in depth behind the connection handshake: never run
+            # a spec stamped by a different pod incarnation.
+            ok = reply({"status": "fail", "round": rnd,
+                        "error": f"stale mesh epoch: spec epoch "
+                                 f"{spec.get('epoch')} != worker {epoch}"})
         else:
             try:
                 device_ops = prepper(store, runtime, spec)
@@ -657,14 +786,14 @@ def worker_loop(store, runtime) -> None:
                             "error": f"{type(exc).__name__}: {exc}"})
         if not ok:
             log.info("controller lost while acking; exiting")
-            return
+            return "controller-lost"
         # Await the controller's verdict for this round (blocking: the
         # controller may legitimately spend minutes collecting other
         # workers' acks; its death surfaces as EOF).
         status, line = conn.recv_line(None)
         if status != "ok":
             log.info("controller lost mid-round; exiting")
-            return
+            return "controller-lost"
         verdict = json.loads(line).get("op")
         if verdict == "go" and device_ops is not None:
             try:
@@ -673,7 +802,7 @@ def worker_loop(store, runtime) -> None:
             except Exception:  # noqa: BLE001 — keep the loop alive
                 log.exception("worker device ops for %r failed", op)
         elif verdict == "shutdown":
-            return
+            return "shutdown"
 
 
 def shutdown_workers() -> None:

@@ -41,9 +41,20 @@ def main() -> None:
 
         print(f"learningorchestra_tpu worker "
               f"{jax.process_index()}/{jax.process_count()} "
-              f"(devices: {distributed.process_info()['devices']})",
+              f"(devices: {distributed.process_info()['devices']}, "
+              f"mesh epoch {spmd.mesh_epoch()})",
               flush=True)
-        spmd.worker_loop(DatasetStore(settings), MeshRuntime(settings))
+        reason = spmd.worker_loop(DatasetStore(settings),
+                                  MeshRuntime(settings))
+        if reason != "shutdown":
+            # Controller lost or this worker's epoch went stale: this
+            # incarnation cannot continue, but the POD should — exit
+            # with the restartable code so the host's supervisor
+            # (supervisor.py) restarts the process into the pod's next
+            # incarnation instead of counting a local failure.
+            from learningorchestra_tpu.supervisor import RESTARTABLE_EXIT
+
+            raise SystemExit(RESTARTABLE_EXIT)
         return
 
     from learningorchestra_tpu.parallel import spmd

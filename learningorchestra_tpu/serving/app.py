@@ -18,23 +18,27 @@ the upgrade that failed jobs set ``error`` and still flip ``finished``.
 from __future__ import annotations
 
 import json
+import logging
+import os
 from typing import Optional
 
 from learningorchestra_tpu.catalog.ingest import ingest_csv_url
 from learningorchestra_tpu.catalog.store import (
     DatasetExists, DatasetNotFound, DatasetStore)
 from learningorchestra_tpu.config import Settings, settings as global_settings
-from learningorchestra_tpu.jobs import JobManager
+from learningorchestra_tpu.jobs import JobManager, select_retry_groups
 from learningorchestra_tpu.models.builder import ModelBuilder
 from learningorchestra_tpu.ops.dtypes import convert_fields
 from learningorchestra_tpu.ops.histogram import create_histogram
 from learningorchestra_tpu.ops.projection import create_projection
-from learningorchestra_tpu.parallel import distributed
+from learningorchestra_tpu.parallel import distributed, spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
 from learningorchestra_tpu.serving.http import (
     FileResponse, HtmlResponse, HttpError, Router, Server)
 from learningorchestra_tpu.viz.service import (
     ImageExists, ImageNotFound, ImageService, create_embedding_image)
+
+log = logging.getLogger("lo_tpu.serving")
 
 
 class App:
@@ -60,6 +64,13 @@ class App:
         self.images = {m: ImageService(m, self.cfg) for m in ("tsne", "pca")}
         self.router = Router()
         self._register()
+        if recover and self.cfg.persist:
+            # Jobs killed by infrastructure (a pod worker death, a process
+            # restart mid-job) re-run automatically from their recorded
+            # specs — the Spark lost-task re-execution analogue. Must run
+            # after _register: the retry runners reuse the same builder /
+            # op entry points the routes do.
+            self._rescan_failed_jobs()
 
     # -- helpers -------------------------------------------------------------
 
@@ -69,6 +80,12 @@ class App:
         def inner(req):
             try:
                 return fn(req)
+            except spmd.PodDegraded as e:
+                # A degraded pod is mid-recovery (its supervisor restarts
+                # it under a new mesh epoch): answer 503 + Retry-After so
+                # clients back off and retry, instead of a 500 that reads
+                # as a server bug.
+                raise HttpError(503, str(e), headers={"Retry-After": "5"})
             except DatasetNotFound as e:
                 raise HttpError(404, f"dataset not found: {e}")
             except ImageNotFound as e:
@@ -136,7 +153,9 @@ class App:
             missing = [f for f in fields if f not in parent_fields]
             if missing:
                 raise ValueError(f"fields not in dataset: {missing}")
-            app.store.create(name, parent=parent)
+            app.store.create(name, parent=parent, extra={"job": {
+                "kind": "projection", "parent": parent, "name": name,
+                "fields": list(fields)}})
             app.jobs.submit(
                 "projection", name,
                 lambda: create_projection(app.store, parent, name, fields,
@@ -146,6 +165,7 @@ class App:
         # ---- histogram (reference histogram_image/server.py)
         @self._route("POST", "/histograms/{parent}")
         def histogram(req):
+            spmd.require_pod_health()
             parent = req.params["parent"]
             name, fields = req.require("histogram_filename", "fields")
             if not app.store.exists(parent):
@@ -154,7 +174,9 @@ class App:
             missing = [f for f in fields if f not in parent_fields]
             if missing:
                 raise ValueError(f"fields not in dataset: {missing}")
-            app.store.create(name, parent=parent)
+            app.store.create(name, parent=parent, extra={"job": {
+                "kind": "histogram", "parent": parent, "name": name,
+                "fields": list(fields)}})
             app.jobs.submit(
                 "histogram", name,
                 lambda: create_histogram(app.store, app.runtime, parent,
@@ -170,6 +192,7 @@ class App:
         # ---- model_builder (reference model_builder_image/server.py:52-115)
         @self._route("POST", "/models")
         def models(req):
+            spmd.require_pod_health()
             (train, test, pred_name, classifiers, label) = req.require(
                 "training_filename", "test_filename", "prediction_filename",
                 "classificators_list", "label")
@@ -193,10 +216,22 @@ class App:
             # Create every prediction dataset up front (metadata-first), so
             # a failure at ANY point of the async build is pollable on all
             # of them — never the reference's finished:false-forever state.
+            # Each carries the job spec that created it: if the pod dies
+            # mid-build, the restarted incarnation re-runs the build from
+            # this record (exec preprocessor code is excluded — an exec
+            # job is not provably re-runnable, so it fails permanently).
             pred_datasets = [f"{pred_name}_{c}" for c in classifiers]
+            job_spec = None if code is not None else {
+                "kind": "model_builder", "train": train, "test": test,
+                "pred_name": pred_name, "classifiers": list(classifiers),
+                "label": label, "steps": list(steps),
+                "hparams": hparams or {}}
             for c in classifiers:
+                extra = {"classifier": c, "label": label}
+                if job_spec is not None:
+                    extra["job"] = job_spec
                 app.store.create(f"{pred_name}_{c}", parent=test,
-                                 extra={"classifier": c, "label": label})
+                                 extra=extra)
 
             def run():
                 app.builder.build(train, test, pred_name, classifiers, label,
@@ -220,6 +255,7 @@ class App:
 
         @self._route("POST", "/trained-models/{name}/predictions")
         def model_predict(req):
+            spmd.require_pod_health()
             name = req.params["name"]
             dataset, out = req.require("dataset_name", "prediction_filename")
             if app.store.exists(out):
@@ -240,7 +276,11 @@ class App:
             # requests collide on the created dataset (409), and a crash
             # mid-predict leaves a pollable failure record.
             app.store.create(out, parent=dataset,
-                             extra={"model": name, "kind": man["kind"]})
+                             extra={"model": name, "kind": man["kind"],
+                                    "job": {"kind": "model_predict",
+                                            "model": name,
+                                            "dataset": dataset,
+                                            "out": out}})
             app.jobs.submit(
                 "model_predict", out,
                 lambda: app.builder.predict(name, dataset, out,
@@ -255,8 +295,15 @@ class App:
         # ---- observability (upgrade; reference exposed Spark UIs only)
         @self._route("GET", "/cluster")
         def cluster(_req):
+            # The supervisor polls this: ``pod_error`` non-null means the
+            # pod is degraded and should be restarted under a new epoch.
             info = distributed.process_info()
             info["mesh"] = dict(app.runtime.mesh.shape)
+            info["mesh_epoch"] = spmd.mesh_epoch()
+            info["pod_error"] = spmd.pod_error()
+            info["healthy"] = info["pod_error"] is None
+            info["restarts"] = int(
+                os.environ.get("LO_TPU_RESTART_COUNT", "0") or 0)
             return 200, info
 
         @self._route("GET", "/jobs")
@@ -273,6 +320,8 @@ class App:
 
             info = distributed.process_info()
             info["mesh"] = dict(app.runtime.mesh.shape)
+            info["mesh_epoch"] = spmd.mesh_epoch()
+            info["pod_error"] = spmd.pod_error()
             return 200, HtmlResponse(render_status(
                 info, app.jobs.records(), app.store.metadata_docs()))
 
@@ -294,6 +343,7 @@ class App:
 
         @self._route("POST", f"/{method}/images/{{parent}}")
         def create_image(req, method=method, svc=svc):
+            spmd.require_pod_health()
             name = req.body.get("image_name") or req.body.get(
                 f"{method}_filename")
             if not name:
@@ -347,6 +397,68 @@ class App:
             if app.store.exists(marker):
                 app.store.delete(marker)
             return 200, {"result": "deleted"}
+
+    # -- automatic job retry (elastic recovery, supervisor.py) ---------------
+
+    def _retry_runner(self, spec, names):
+        """The re-run callable for one recorded job spec (owning the
+        failed output datasets ``names``), or None for an unknown kind
+        (a newer incarnation's spec — leave it failed)."""
+        kind = spec.get("kind")
+        if kind == "model_builder":
+            # Re-fit only the classifiers whose outputs failed: ones that
+            # finished before the pod died keep their results (re-running
+            # them would append duplicate prediction rows).
+            pred = spec["pred_name"]
+            classifiers = [c for c in spec["classifiers"]
+                           if f"{pred}_{c}" in set(names)]
+            return lambda: self.builder.build(
+                spec["train"], spec["test"], pred,
+                classifiers, spec["label"],
+                steps=spec.get("steps") or (),
+                hparams=spec.get("hparams") or {}, existing=True)
+        if kind == "histogram":
+            return lambda: create_histogram(
+                self.store, self.runtime, spec["parent"], spec["name"],
+                spec["fields"], existing=True)
+        if kind == "projection":
+            return lambda: create_projection(
+                self.store, spec["parent"], spec["name"], spec["fields"],
+                existing=True)
+        if kind == "model_predict":
+            return lambda: self.builder.predict(
+                spec["model"], spec["dataset"], spec["out"], existing=True)
+        return None
+
+    def _rescan_failed_jobs(self) -> None:
+        """Re-run jobs the previous incarnation lost to infrastructure.
+
+        The watchdog fails a dispatched job's outputs with ``pod
+        failure:`` when a worker dies; a process restart mid-job marks
+        unfinished outputs ``interrupted:`` (catalog load_all). Both mean
+        the JOB was sound but the pod wasn't — so after the supervisor
+        restarts the pod, re-run each such job from the spec recorded in
+        its outputs' metadata, up to ``Settings.job_retries`` attempts
+        per output (tracked in its ``retries`` counter). Outputs are
+        reset via ``DatasetStore.reopen`` first, so pollers see them go
+        back in flight and a partial write never duplicates rows.
+        """
+        if self.cfg.job_retries <= 0:
+            return
+        groups = select_retry_groups(self.store.metadata_docs(),
+                                     self.cfg.job_retries)
+        for group in groups:
+            spec, names = group["spec"], group["datasets"]
+            runner = self._retry_runner(spec, names)
+            if runner is None:
+                log.warning("not retrying %s: unknown job kind %r",
+                            names, spec.get("kind"))
+                continue
+            for name in names:
+                self.store.reopen(name)
+            log.info("retrying %s job for %s (pod recovered)",
+                     spec["kind"], names)
+            self.jobs.submit(f"retry_{spec['kind']}", names, runner)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -21,10 +21,15 @@ from urllib.parse import parse_qs, urlparse
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        #: Extra response headers — e.g. the 503 pod-degraded answer
+        #: carries Retry-After so clients back off for a restart window
+        #: instead of hammering a pod mid-recovery.
+        self.headers = dict(headers or {})
 
 
 class Request:
@@ -114,11 +119,14 @@ def _make_handler(router: Router):
             except json.JSONDecodeError:
                 raise HttpError(400, "invalid JSON body")
 
-        def _send_json(self, status: int, payload: Any) -> None:
+        def _send_json(self, status: int, payload: Any,
+                       headers: Optional[Dict[str, str]] = None) -> None:
             data = json.dumps(payload, default=str).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -150,7 +158,8 @@ def _make_handler(router: Router):
                 else:
                     self._send_json(status, payload)
             except HttpError as e:
-                self._send_json(e.status, {"result": e.message})
+                self._send_json(e.status, {"result": e.message},
+                                headers=e.headers)
             except Exception as e:  # noqa: BLE001 — request boundary
                 traceback.print_exc()
                 self._send_json(500, {"result": f"internal error: {e}"})

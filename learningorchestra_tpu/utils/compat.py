@@ -1,0 +1,28 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern ``jax.shard_map`` API (top-level export,
+``check_vma`` kwarg). Older runtimes (jax < 0.5) ship the same machinery
+as ``jax.experimental.shard_map.shard_map`` with the ``check_rep`` kwarg.
+Rather than pinning a floor version (the container environment is fixed —
+see the no-new-deps constraint), this module adapts at import time so
+every kernel and mesh op runs unchanged on either runtime. Imported for
+its side effect by ``parallel/__init__`` — the gateway every compute
+module loads through — so jax-free entry points (the pod supervisor, the
+client SDK) never pay the jax import.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map

@@ -27,6 +27,10 @@ os.environ["LO_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (jax 0.4.x needs explicit gloo)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
                            num_processes=nprocs, process_id=pid)
 

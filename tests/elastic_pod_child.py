@@ -1,0 +1,108 @@
+"""Pod process for the supervised elastic-recovery chaos test
+(tests/test_multiprocess.py::test_elastic_recovery_supervised_restart).
+
+Run under a :class:`learningorchestra_tpu.supervisor.Supervisor`, one
+instance per pod process. At every mesh epoch below ``die_below_epoch``
+the worker SIGKILLs itself at its first device op after 'go' (the
+mid-collective window); process 0's watchdog fails the job's outputs
+and poisons the pod, the supervisor restarts both processes under the
+next epoch, and the restarted process 0's retry rescan re-runs the
+recorded build — which succeeds once the fault window has passed.
+
+Run as: python tests/elastic_pod_child.py <process_id> <num_processes>
+<coord_port> <http_port> <shared_root> [die_below_epoch=1].
+"""
+
+import os
+import signal
+import sys
+
+pid, nprocs, coord_port, http_port, root = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+    int(sys.argv[4]), sys.argv[5])
+die_below_epoch = int(sys.argv[6]) if len(sys.argv) > 6 else 1
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+os.environ["LO_TPU_COORDINATOR"] = f"127.0.0.1:{coord_port}"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (jax 0.4.x needs explicit gloo)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{coord_port}",
+                           num_processes=nprocs, process_id=pid)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from learningorchestra_tpu.config import Settings  # noqa: E402
+from learningorchestra_tpu.parallel import spmd  # noqa: E402
+
+cfg = Settings()          # job_retries etc. come from the supervisor's env
+cfg.store_root = os.path.join(root, "store")
+cfg.persist = True
+cfg.host = "127.0.0.1"
+cfg.port = http_port
+
+epoch = spmd.mesh_epoch()
+
+
+def make_split(seed, n):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    y = (a > 0).astype(np.int64)
+    return {"a": a, "label": y}
+
+
+if pid == 0:
+    from learningorchestra_tpu.serving.app import App
+
+    spmd.ensure_channel()
+    app = App(cfg)          # epoch >= 1: recovery rescan resubmits here
+    if epoch == 0:
+        app.store.create("e_train", columns=make_split(0, 2000),
+                         finished=True)
+        app.store.create("e_test", columns=make_split(1, 500),
+                         finished=True)
+        # Submit the async build exactly as POST /models sync=false does:
+        # metadata-first output carrying the re-runnable job spec.
+        job_spec = {"kind": "model_builder", "train": "e_train",
+                    "test": "e_test", "pred_name": "e_pred",
+                    "classifiers": ["lr"], "label": "label",
+                    "steps": [], "hparams": {}}
+        app.store.create("e_pred_lr", parent="e_test",
+                         extra={"classifier": "lr", "label": "label",
+                                "job": job_spec})
+        app.jobs.submit(
+            "model_builder", ["e_pred_lr"],
+            lambda: app.builder.build("e_train", "e_test", "e_pred",
+                                      ["lr"], "label", existing=True))
+    print(f"elastic child 0 serving at epoch {epoch}", flush=True)
+    app.serve()             # blocks; the supervisor kills/restarts us
+else:
+    from learningorchestra_tpu.catalog.store import DatasetStore
+    from learningorchestra_tpu.parallel.mesh import MeshRuntime
+
+    store = DatasetStore(cfg)
+    runtime = MeshRuntime(cfg)
+    if epoch < die_below_epoch:
+        # Fault injection, early incarnations only: prep normally
+        # (realistic 'ready' ack), then die by SIGKILL at the first
+        # device op after 'go' — the mid-collective window.
+        real_prepper = spmd._PREPPERS["build"]
+
+        def dying_prepper(store_, runtime_, spec):
+            real_prepper(store_, runtime_, spec)
+            return lambda: os.kill(os.getpid(), signal.SIGKILL)
+
+        spmd._PREPPERS["build"] = dying_prepper
+    print(f"elastic child {pid} entering worker loop at epoch {epoch}",
+          flush=True)
+    reason = spmd.worker_loop(store, runtime)
+    sys.exit(0 if reason == "shutdown" else 3)

@@ -196,6 +196,31 @@ def test_finish_and_fail_protocol(store):
     assert doc["finished"] is True and doc["error"] == "boom"
 
 
+def test_finish_refuses_failed_dataset(store):
+    """Regression (ADVICE r5 #3): a worker death after the last collective
+    fails the output via the watchdog while process 0's compute still
+    completes — its late ``finish`` must NOT flip the dataset back to
+    success, and a late ``fail`` must not overwrite the root cause."""
+    from learningorchestra_tpu.catalog.store import DatasetFailed
+
+    store.create("out", columns=_mkcols())
+    store.fail("out", "pod failure: worker died mid-job")
+    with pytest.raises(DatasetFailed):
+        store.finish("out", f1=0.99)
+    meta = store.get("out").metadata
+    assert meta.error == "pod failure: worker died mid-job"
+    assert "f1" not in meta.extra
+    # First failure wins: cascading errors keep the original record.
+    store.fail("out", "TypeError: late cascade")
+    assert store.get("out").metadata.error == \
+        "pod failure: worker died mid-job"
+    # A successfully-finished dataset is terminal too.
+    store.create("done", columns=_mkcols())
+    store.finish("done")
+    store.fail("done", "late failure")
+    assert store.get("done").metadata.error is None
+
+
 def test_value_counts(store):
     cols = {"sex": np.array(["m", "f", "m", "m"], dtype=object)}
     store.create("d", columns=cols, finished=True)

@@ -15,6 +15,8 @@ import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 
 import pytest
 
@@ -22,6 +24,8 @@ _CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "spmd_child.py")
 _CHAOS_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "chaos_child.py")
+_ELASTIC_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "elastic_pod_child.py")
 
 
 def _free_port() -> int:
@@ -30,7 +34,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_model_build(tmp_path):
+    # Slow: the full API surface (two fits + streamed fit + tsne + pca +
+    # histogram) over real cross-process gloo collectives takes several
+    # minutes on CPU. Tier-1's fast multi-process coverage is the chaos
+    # and elastic-recovery tests below.
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
@@ -44,7 +53,7 @@ def test_two_process_model_build(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=900)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -114,3 +123,124 @@ def test_worker_death_mid_job_fails_pollably(tmp_path):
     assert result["second_job"].startswith("refused"), result
     assert "degraded" in result["second_job"], result
     assert result["second_job_s"] < 10.0, result
+
+
+def test_elastic_recovery_supervised_restart(tmp_path):
+    """The full detect → fail → restart → retry → succeed loop (ISSUE 2
+    tentpole): SIGKILL a worker mid-collective; the watchdog flips the
+    job's output to a pollable failure; the supervisor restarts the pod
+    under a new mesh epoch; the restarted process 0 rescans the store and
+    re-runs the recorded build, which completes with correct outputs —
+    no human intervention anywhere."""
+    import requests
+
+    from learningorchestra_tpu.config import Settings
+    from learningorchestra_tpu.supervisor import Supervisor
+
+    coord_port = _free_port()
+    http_port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LO_TPU_MESH_EPOCH",
+                        "LO_TPU_RESTART_COUNT")}
+    cmds = [[sys.executable, _ELASTIC_CHILD, str(i), "2", str(coord_port),
+             str(http_port), str(tmp_path)] for i in range(2)]
+    cfg = Settings()
+    cfg.restart_budget = 3
+    cfg.restart_backoff_s = 0.2
+    cfg.restart_backoff_max_s = 1.0
+    cfg.health_interval_s = 0.5
+    sup = Supervisor(
+        cmds, cfg=cfg, env=env,
+        health_url=f"http://127.0.0.1:{http_port}/cluster")
+    runner = threading.Thread(target=sup.run, daemon=True)
+    runner.start()
+    try:
+        meta_path = tmp_path / "store" / "e_pred_lr" / "metadata.json"
+        deadline = time.time() + 300
+        doc = None
+        while time.time() < deadline:
+            if meta_path.is_file():
+                got = json.loads(meta_path.read_text() or "{}")
+                if got.get("finished") and not got.get("error") \
+                        and got.get("retries"):
+                    doc = got
+                    break
+            time.sleep(0.5)
+        assert doc is not None, (
+            "retried job never reached a clean terminal state "
+            f"(supervisor: restarts={sup.restarts}, epoch={sup.epoch}, "
+            f"failure={sup.failure})")
+        # Exactly one automatic retry, after exactly one supervised
+        # restart under a new mesh epoch.
+        assert doc["retries"] == 1, doc
+        assert sup.restarts == 1, sup.failure
+        assert sup.epoch == 1
+        # The retried fit is genuinely good, not just terminal.
+        assert doc["f1"] > 0.85, doc
+        # The recovered pod reports the new epoch and full health.
+        info = requests.get(f"http://127.0.0.1:{http_port}/cluster",
+                            timeout=10).json()
+        assert info["mesh_epoch"] == 1, info
+        assert info["healthy"] is True, info
+        assert info["pod_error"] is None, info
+        assert info["process_count"] == 2, info
+    finally:
+        sup.close()
+        runner.join(timeout=15)
+
+
+@pytest.mark.slow
+def test_elastic_recovery_survives_repeated_failures(tmp_path):
+    """Long restart-loop variant: the worker dies mid-collective in the
+    first TWO incarnations. The supervisor's backoff/budget absorbs both
+    (epoch 0 → 1 → 2) and the job retry budget (LO_TPU_JOB_RETRIES=2)
+    covers the repeated loss; the third incarnation succeeds."""
+    import requests
+
+    from learningorchestra_tpu.config import Settings
+    from learningorchestra_tpu.supervisor import Supervisor
+
+    coord_port = _free_port()
+    http_port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LO_TPU_MESH_EPOCH",
+                        "LO_TPU_RESTART_COUNT")}
+    env["LO_TPU_JOB_RETRIES"] = "2"
+    cmds = [[sys.executable, _ELASTIC_CHILD, str(i), "2", str(coord_port),
+             str(http_port), str(tmp_path), "2"] for i in range(2)]
+    cfg = Settings()
+    cfg.restart_budget = 4
+    cfg.restart_backoff_s = 0.2
+    cfg.restart_backoff_max_s = 1.0
+    cfg.health_interval_s = 0.5
+    sup = Supervisor(
+        cmds, cfg=cfg, env=env,
+        health_url=f"http://127.0.0.1:{http_port}/cluster")
+    runner = threading.Thread(target=sup.run, daemon=True)
+    runner.start()
+    try:
+        meta_path = tmp_path / "store" / "e_pred_lr" / "metadata.json"
+        deadline = time.time() + 420
+        doc = None
+        while time.time() < deadline:
+            if meta_path.is_file():
+                got = json.loads(meta_path.read_text() or "{}")
+                if got.get("finished") and not got.get("error") \
+                        and got.get("retries", 0) >= 2:
+                    doc = got
+                    break
+            time.sleep(0.5)
+        assert doc is not None, (
+            "job never recovered from repeated failures "
+            f"(supervisor: restarts={sup.restarts}, epoch={sup.epoch}, "
+            f"failure={sup.failure})")
+        assert doc["retries"] == 2, doc
+        assert doc["f1"] > 0.85, doc
+        assert sup.restarts == 2, sup.failure
+        assert sup.epoch == 2
+        info = requests.get(f"http://127.0.0.1:{http_port}/cluster",
+                            timeout=10).json()
+        assert info["mesh_epoch"] == 2 and info["healthy"], info
+    finally:
+        sup.close()
+        runner.join(timeout=15)

@@ -218,6 +218,32 @@ def test_shard_chunked_reads_only_per_shard_ranges(store, runtime):
     assert covered[0][0] == 0 and covered[-1][1] >= 1037
 
 
+def test_chunked_design_pins_snapshot_across_rewrites(store):
+    """ADVICE r5 #2: a concurrent ``set_column`` generation rewrite during
+    a streamed build must not mix pre-/post-rewrite rows — the
+    ChunkedDesign (and every fitting pass) reads through ONE pinned chunk
+    snapshot for its whole lifetime."""
+    ds = _fill_ds(store, "pin", n=1200, chunk=100)
+    X, y, ff, state = preprocess.design_matrix_streamed(ds, "y")
+    before_first = X.rows(0, 64)
+
+    # Rewrite a feature column mid-build (new chunk generation).
+    ds.set_column("num", np.full(ds.num_rows, 1e6))
+    ds.set_column("intc", np.zeros(ds.num_rows, dtype=np.int64))
+
+    # Ranges materialized AFTER the rewrite still come from the pinned
+    # pre-rewrite snapshot — identical to a full pre-rewrite read.
+    assert np.array_equal(X.rows(0, 64), before_first)
+    tail = X.rows(1100, 1200)
+    assert np.isfinite(tail).all()
+    assert not np.any(tail == 1e6)
+
+    # A design built after the rewrite sees only the new generation.
+    X2, _, _, _ = preprocess.design_matrix_streamed(
+        ds, "y", feature_fields=ff)
+    assert np.all(X2.rows(0, 64)[:, ff.index("num")] == 1e6)
+
+
 def test_streamed_build_never_consolidates(cfg, monkeypatch):
     """End-to-end: fit lr + gb on a dataset OVER its RAM budget with
     consolidation forbidden — bounded per-process memory by construction —

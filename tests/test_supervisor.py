@@ -1,0 +1,393 @@
+"""Elastic-recovery unit pieces (supervisor.py, spmd epoch machinery,
+job-retry selection) — the fast complements to the end-to-end chaos test
+in tests/test_multiprocess.py.
+
+Covers: mesh-epoch handshake rejection on the job channel, epoch-scoped
+pod poison, supervisor restart backoff + budget exhaustion (with the
+failure served via the fallback /cluster), health-poll-triggered
+restart, and the failed-job rescan/retry selection + re-run.
+"""
+
+import json
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.jobs import select_retry_groups
+from learningorchestra_tpu.parallel import spmd
+from learningorchestra_tpu.supervisor import Supervisor
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_pod_state(monkeypatch):
+    """Every test starts at epoch 0 with an unpoisoned pod."""
+    monkeypatch.setattr(spmd, "_pod_error", None)
+    monkeypatch.delenv("LO_TPU_MESH_EPOCH", raising=False)
+    yield
+
+
+# -- mesh-epoch handshake -----------------------------------------------------
+
+def _hello(port: int, epoch) -> dict:
+    """Connect to the job channel, send a hello, return the reply doc."""
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as sock:
+        sock.sendall((json.dumps({"op": "hello", "epoch": epoch}) + "\n")
+                     .encode())
+        sock.settimeout(5)
+        buf = b""
+        while b"\n" not in buf:
+            data = sock.recv(4096)
+            if not data:
+                return {"op": "eof"}
+            buf += data
+        return json.loads(buf.split(b"\n", 1)[0])
+
+
+def test_job_channel_rejects_stale_epoch_worker(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("LO_TPU_JOB_PORT", str(port))
+    monkeypatch.setenv("LO_TPU_MESH_EPOCH", "2")
+    chan = spmd._JobChannel(n_workers=1)
+    try:
+        # A worker from a previous incarnation (epoch 1) is turned away
+        # with a reasoned reject and never occupies a worker slot.
+        reply = _hello(port, epoch=1)
+        assert reply["op"] == "reject"
+        assert "epoch" in reply["reason"]
+        time.sleep(0.1)
+        assert len(chan._live()) == 0
+
+        # The current incarnation's worker is welcomed and counted.
+        reply = _hello(port, epoch=2)
+        assert reply["op"] == "welcome"
+        assert reply["epoch"] == 2
+        deadline = time.time() + 5
+        while len(chan._live()) < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(chan._live()) == 1
+    finally:
+        chan.close()
+
+
+def test_job_channel_rejects_garbage_handshake(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("LO_TPU_JOB_PORT", str(port))
+    chan = spmd._JobChannel(n_workers=1)
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(b"not json at all\n")
+            s.settimeout(5)
+            data = s.recv(4096)
+            # The channel must answer with a reject line (or just close)
+            # — never a welcome.
+            assert data == b"" or b'"reject"' in data, data
+        time.sleep(0.1)
+        assert len(chan._live()) == 0
+    finally:
+        chan.close()
+
+
+# -- epoch-scoped pod poison --------------------------------------------------
+
+def test_pod_poison_clears_on_epoch_bump(monkeypatch):
+    monkeypatch.setenv("LO_TPU_MESH_EPOCH", "0")
+    spmd._set_pod_error("worker died mid-job")
+    assert spmd.pod_error() == "worker died mid-job"
+    with pytest.raises(spmd.PodDegraded):
+        spmd.require_pod_health()
+    # The supervisor restarts the pod under the next epoch: poison from
+    # the previous incarnation no longer degrades it.
+    monkeypatch.setenv("LO_TPU_MESH_EPOCH", "1")
+    assert spmd.pod_error() is None
+    spmd.require_pod_health()  # no raise
+
+
+# -- supervisor restart/backoff/budget ---------------------------------------
+
+def _fast(sup: Supervisor) -> Supervisor:
+    sup.SETTLE_S = 0.05
+    sup.TERM_GRACE_S = 1.0
+    return sup
+
+
+def test_supervisor_clean_exit_no_restart():
+    cfg = Settings()
+    cfg.restart_budget = 3
+    cfg.restart_backoff_s = 0.05
+    sup = _fast(Supervisor([[sys.executable, "-c", "pass"]], cfg=cfg))
+    assert sup.run() == 0
+    assert sup.restarts == 0
+    assert sup.epoch == 0
+
+
+def test_supervisor_budget_exhaustion_serves_reason():
+    import requests
+
+    cfg = Settings()
+    cfg.restart_budget = 2
+    cfg.restart_backoff_s = 0.05
+    cfg.restart_backoff_max_s = 0.2
+    port = _free_port()
+    sup = _fast(Supervisor(
+        [[sys.executable, "-c", "import sys; sys.exit(7)"]],
+        cfg=cfg, fallback_port=port))
+    try:
+        assert sup.run() == 1
+        # Budget of 2 restarts was spent, then the third incident gave up;
+        # each restart advanced the mesh epoch.
+        assert sup.restarts == 3
+        assert sup.epoch == 2
+        assert "restart budget exhausted" in sup.failure
+        assert "exited with code 7" in sup.failure
+        # The failed pod stays observable: /cluster reports the reason.
+        info = requests.get(f"http://127.0.0.1:{port}/cluster",
+                            timeout=5).json()
+        assert info["healthy"] is False
+        assert "restart budget exhausted" in info["pod_error"]
+        assert info["restarts"] == 3
+    finally:
+        sup.close()
+
+
+def test_supervisor_health_poll_triggers_restart():
+    from learningorchestra_tpu.serving.http import Router, Server
+
+    # A fake process-0 /cluster reporting a degraded pod: the supervisor
+    # must restart the (still-running) child from the health signal alone.
+    router = Router()
+
+    @router.route("GET", "/cluster")
+    def cluster(_req):
+        return 200, {"pod_error": "worker connection lost mid-job",
+                     "healthy": False}
+
+    srv = Server(router, "127.0.0.1", 0).start_background()
+    cfg = Settings()
+    cfg.restart_budget = 0          # first incident exhausts immediately
+    cfg.restart_backoff_s = 0.05
+    cfg.health_interval_s = 0.1
+    sup = _fast(Supervisor(
+        [[sys.executable, "-c", "import time; time.sleep(60)"]],
+        cfg=cfg,
+        health_url=f"http://127.0.0.1:{srv.port}/cluster"))
+    try:
+        assert sup.run() == 1
+        assert "pod degraded: worker connection lost mid-job" in sup.failure
+    finally:
+        sup.close()
+        srv.stop()
+
+
+def test_epoch_file_owner_publishes_and_follower_follows(tmp_path):
+    import os as _os
+    import threading as _threading
+
+    root = str(tmp_path / "store")
+    epoch_file = tmp_path / "store" / ".mesh_epoch"
+
+    # Host 0's supervisor OWNS the shared epoch: each restart increments
+    # and publishes it.
+    cfg = Settings()
+    cfg.restart_budget = 1
+    cfg.restart_backoff_s = 0.05
+    owner_env = {**_os.environ, "LO_TPU_STORE_ROOT": root}
+    owner_env.pop("LO_TPU_PROCESS_ID", None)
+    owner = _fast(Supervisor(
+        [[sys.executable, "-c", "import sys; sys.exit(9)"]],
+        cfg=cfg, env=owner_env))
+    assert owner.epoch_owner
+    assert owner.run() == 1          # one restart spent, then exhausted
+    assert epoch_file.read_text() == "1"
+
+    # A worker host's supervisor FOLLOWS: it adopts the published epoch
+    # at spawn, and a file change restarts its children at the new epoch
+    # WITHOUT consuming its restart budget.
+    fcfg = Settings()
+    fcfg.restart_budget = 3
+    fcfg.restart_backoff_s = 0.05
+    fcfg.health_interval_s = 0.1
+    follower = _fast(Supervisor(
+        [[sys.executable, "-c", "import time; time.sleep(60)"]],
+        cfg=fcfg,
+        env={**_os.environ, "LO_TPU_STORE_ROOT": root,
+             "LO_TPU_PROCESS_ID": "1"}))
+    assert not follower.epoch_owner
+    assert follower.epoch == 1
+    t = _threading.Thread(target=follower.run, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.5)
+        epoch_file.write_text("5")   # the pod restarted under host 0
+        deadline = time.time() + 10
+        while follower.epoch != 5 and time.time() < deadline:
+            time.sleep(0.05)
+        assert follower.epoch == 5
+        assert follower.restarts == 0   # coordinated follow-up, not budget
+    finally:
+        follower.request_stop()
+        t.join(timeout=10)
+
+
+# -- failed-job rescan/retry selection ---------------------------------------
+
+def _doc(name, error=None, finished=True, job=None, retries=0):
+    doc = {"_id": 0, "filename": name, "finished": finished,
+           "fields": [], "retries": retries}
+    if error:
+        doc["error"] = error
+    if job:
+        doc["job"] = job
+    return doc
+
+
+def test_select_retry_groups_selection_rules():
+    build_job = {"kind": "model_builder", "train": "t", "test": "s",
+                 "pred_name": "p", "classifiers": ["lr", "nb"],
+                 "label": "y", "steps": [], "hparams": {}}
+    hist_job = {"kind": "histogram", "parent": "d", "name": "h",
+                "fields": ["v"]}
+    docs = [
+        # Two outputs of ONE build job, both pod-failed → one group.
+        _doc("p_lr", error="pod failure: worker died", job=build_job),
+        _doc("p_nb", error="interrupted: server restarted mid-job",
+             job=build_job),
+        # Pod-failed but retries already spent → skipped.
+        _doc("h", error="pod failure: worker died", job=hist_job,
+             retries=1),
+        # User-caused failure → never retried.
+        _doc("bad", error="ValueError: label field 'y' not in 'train'",
+             job=hist_job),
+        # Pod-failed but no recorded job spec → cannot re-run.
+        _doc("orphan", error="pod failure: worker died"),
+        # Healthy / in-flight datasets → untouched.
+        _doc("ok"),
+        _doc("running", finished=False),
+    ]
+    groups = select_retry_groups(docs, max_retries=1)
+    assert len(groups) == 1
+    assert groups[0]["spec"] == build_job
+    assert sorted(groups[0]["datasets"]) == ["p_lr", "p_nb"]
+    # A bigger budget admits the once-retried histogram too.
+    groups = select_retry_groups(docs, max_retries=2)
+    assert {g["spec"]["kind"] for g in groups} == {"model_builder",
+                                                  "histogram"}
+
+
+def test_pod_degraded_job_failure_is_retryable(tmp_path):
+    """A job REFUSED because the pod is degraded (queued behind the one
+    whose worker died) failed from infrastructure: it must record the
+    retryable ``pod failure:`` prefix so the restarted pod re-runs it,
+    not a bespoke error that strands it failed forever."""
+    from learningorchestra_tpu.catalog.store import DatasetStore
+    from learningorchestra_tpu.jobs import JobManager
+    from learningorchestra_tpu.parallel.spmd import PodDegraded
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.persist = False
+    store = DatasetStore(cfg)
+    store.create("q_out")
+    jm = JobManager(store)
+
+    def refused():
+        raise PodDegraded("pod is degraded (worker died mid-job)")
+
+    jm.submit("model_builder", "q_out", refused)
+    jm.wait_all(timeout=10)
+    meta = store.get("q_out").metadata
+    assert meta.finished
+    assert meta.error.startswith("pod failure:")
+    groups = select_retry_groups(
+        [dict(meta.to_doc(), job={"kind": "model_builder", "train": "t",
+                                  "test": "s", "pred_name": "q",
+                                  "classifiers": ["lr"], "label": "y"})], 1)
+    assert len(groups) == 1
+
+
+def test_store_reopen_resets_failed_dataset(tmp_path):
+    from learningorchestra_tpu.catalog.store import DatasetStore
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.persist = True
+    store = DatasetStore(cfg)
+    store.create("out", columns={"v": np.arange(5)})
+    store.fail("out", "pod failure: worker died mid-job")
+    ds = store.reopen("out")
+    assert ds.metadata.finished is False
+    assert ds.metadata.error is None
+    assert ds.metadata.extra["retries"] == 1
+    assert ds.num_rows == 0            # partial rows dropped for the re-run
+    # The reset state is durable (the restarted pod polls it in-flight).
+    doc = json.loads(
+        (tmp_path / "store" / "out" / "metadata.json").read_text())
+    assert doc["finished"] is False and "error" not in doc
+
+
+def test_app_rescan_retries_failed_job(tmp_path):
+    """Single-process end-to-end of the retry half: a store carrying a
+    pod-failed histogram job is recovered by a fresh App, which re-runs
+    the recorded spec and the output reaches a clean terminal state."""
+    from learningorchestra_tpu.catalog.store import DatasetStore
+    from learningorchestra_tpu.serving.app import App
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.image_root = str(tmp_path / "images")
+    cfg.persist = True
+    cfg.job_retries = 1
+    store = DatasetStore(cfg)
+    store.create("h_src", columns={"v": (np.arange(100) % 3)},
+                 finished=True)
+    store.create("h_out", parent="h_src", extra={"job": {
+        "kind": "histogram", "parent": "h_src", "name": "h_out",
+        "fields": ["v"]}})
+    store.fail("h_out", "pod failure: worker died mid-job")
+
+    app = App(cfg)                      # recover + rescan
+    app.jobs.wait_all(timeout=60)
+    meta = app.store.get("h_out").metadata
+    assert meta.finished and meta.error is None
+    assert meta.extra["retries"] == 1
+    counts = app.store.get("h_out").columns["counts"][0]
+    assert counts == {0: 34, 1: 33, 2: 33}
+
+    # A second recovery does NOT retry again (budget spent) even if the
+    # job had failed again — and a clean result is never reopened.
+    app2 = App(cfg)
+    app2.jobs.wait_all(timeout=60)
+    assert app2.store.get("h_out").metadata.extra["retries"] == 1
+
+
+def test_app_rescan_leaves_exhausted_job_failed(tmp_path):
+    from learningorchestra_tpu.serving.app import App
+    from learningorchestra_tpu.catalog.store import DatasetStore
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.image_root = str(tmp_path / "images")
+    cfg.persist = True
+    cfg.job_retries = 1
+    store = DatasetStore(cfg)
+    store.create("h_src", columns={"v": np.arange(10)}, finished=True)
+    store.create("h_out", parent="h_src",
+                 extra={"retries": 1, "job": {
+                     "kind": "histogram", "parent": "h_src",
+                     "name": "h_out", "fields": ["v"]}})
+    store.fail("h_out", "pod failure: worker died mid-job")
+
+    app = App(cfg)
+    app.jobs.wait_all(timeout=60)
+    meta = app.store.get("h_out").metadata
+    assert meta.error and meta.error.startswith("pod failure:")
+    assert meta.extra["retries"] == 1
