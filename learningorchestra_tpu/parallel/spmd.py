@@ -55,6 +55,27 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def serialize_collectives(tree) -> None:
+    """Order-fence for back-to-back dispatched collective programs on a
+    multi-process CPU pod: blocks until ``tree``'s device work completes
+    so the next program's collectives cannot overlap it in flight.
+
+    On TPU this is a no-op — per-device execution streams run enqueued
+    programs strictly in dispatch order, so enqueueing a build's fit
+    programs back-to-back keeps collective order identical on every
+    process (the whole point of the batched dispatch round). The CPU
+    backend has no stream order: in-flight programs execute concurrently
+    on thread pools, so two dispatched programs' gloo collectives can
+    interleave differently per process and corrupt the pod (observed as
+    ``gloo::EnforceNotMet: op.preamble.length <= op.nbytes`` on the
+    2-process test rig). Single-process runs need no fence either —
+    their collectives never cross a process boundary."""
+    import jax
+
+    if is_multiprocess() and jax.default_backend() == "cpu":
+        jax.block_until_ready(tree)
+
+
 def mesh_epoch() -> int:
     """This incarnation's mesh generation. The supervisor
     (learningorchestra_tpu/supervisor.py) bumps ``LO_TPU_MESH_EPOCH`` on
@@ -600,14 +621,32 @@ def prep_build_job(store, runtime, spec: Dict[str, Any]):
                           2 if y_test is None else int(y_test.max()) + 1))
 
     def device_ops() -> None:
+        # Batched dispatch round, mirroring ModelBuilder._build_dispatched
+        # EXACTLY: every family's fit programs enqueue back-to-back first
+        # (async dispatch — no host barrier between fits), then the
+        # probability passes run in the same order. A family that fails
+        # here fails identically on process 0 (deterministic inputs), so
+        # both sides skip the same device ops and collective-program
+        # order stays aligned.
+        models = []
         for c in spec["classifiers"]:
             try:
                 trainer = get_trainer(c)
                 model = trainer(runtime, X_train, y_train, num_classes,
                                 **hparams.get(c, {}))
-                model.predict_proba(runtime, X_test)
+                # Mirrors process 0's phase-1 fence (no-op on TPU).
+                serialize_collectives(model.params)
+                models.append(model)
             except Exception:  # noqa: BLE001 — mirror per-model boundary
                 log.exception("worker fit %s failed", c)
+                models.append(None)
+        for c, model in zip(spec["classifiers"], models):
+            if model is None:
+                continue
+            try:
+                model.predict_proba(runtime, X_test)
+            except Exception:  # noqa: BLE001 — mirror per-model boundary
+                log.exception("worker predict %s failed", c)
 
     return device_ops
 

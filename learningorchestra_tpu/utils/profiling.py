@@ -60,6 +60,27 @@ def timed(name: str, timer: Optional[OpTimer] = None):
         (timer or op_timer).record(name, time.time() - t0)
 
 
+def device_span(fn):
+    """Run ``fn`` (a thunk whose result is a pytree of jax arrays or a
+    value derived from them) and return ``(result, seconds)`` where the
+    span covers program dispatch *through blocked completion* — JAX
+    dispatch is asynchronous, so an unblocked wall-clock around a jitted
+    call measures enqueue time, not compute. ``jax.block_until_ready``
+    walks pytrees, so trainer param dicts work as-is.
+
+    When the caller serializes device work (one fit in its device phase
+    at a time), the span is the fit's device occupancy plus its transfer
+    tail — the ``device_s`` figure that separates tunnel/host jitter from
+    device compute in the bench. Under overlapped dispatch it includes
+    queue waits behind other programs and is reported as such.
+    """
+    import jax
+
+    t0 = time.time()
+    out = jax.block_until_ready(fn())
+    return out, time.time() - t0
+
+
 #: JAX allows one active profiler trace per process; concurrent jobs that
 #: both request tracing serialize on this lock instead of crashing.
 _trace_lock = threading.Lock()

@@ -1,0 +1,26 @@
+"""Shared workload for the pod fit-overlap test: the child (pod build)
+and the parent (single-process reference build) must generate IDENTICAL
+data, so the determinism comparison pins collective-program equality,
+not generator drift."""
+
+import numpy as np
+
+CLASSIFIERS = ["lr", "dt", "rf", "gb", "nb"]
+
+#: Small ensembles keep the CPU pod round in seconds while leaving
+#: enough device work per family for the overlap inequality to have
+#: signal over the dispatch/handshake overhead.
+HPARAMS = {
+    "rf": {"n_trees": 8, "max_depth": 3},
+    "gb": {"n_rounds": 6, "max_depth": 3},
+    "lr": {"iters": 30},
+}
+
+
+def make_columns(seed: int, n: int):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    c = rng.normal(size=n)
+    y = ((a * b + c + 0.3 * rng.normal(size=n)) > 0).astype(np.int64)
+    return {"a": a, "b": b, "c": c, "label": y}

@@ -97,19 +97,75 @@ def test_build_validation_errors(store, runtime, cfg):
 
 
 def test_build_failed_classifier_marks_dataset(store, runtime, cfg):
-    """gb on a 3-class label must fail its dataset but not the others."""
+    """A classifier failing deterministically (gb with n_bins past the
+    uint8 cap) must fail its dataset but not the others. (gb on a
+    3-class label used to be the failure exemplar here; it is now a
+    supported one-vs-rest fit — tests/test_models.py.)"""
     rng = np.random.default_rng(0)
     for name in ("tr3", "te3"):
         store.create(name, columns={
             "x": rng.normal(size=100), "y2": rng.normal(size=100),
             "lab": rng.integers(0, 3, 100).astype(np.int64)}, finished=True)
     mb = ModelBuilder(store, runtime, cfg)
-    reports = mb.build("tr3", "te3", "p3", ["gb", "nb"], "lab")
+    reports = mb.build("tr3", "te3", "p3", ["gb", "nb"], "lab",
+                       hparams={"gb": {"n_bins": 512}})
     by_kind = {r.kind: r for r in reports}
     assert "error" in by_kind["gb"].metrics
     assert store.get("p3_gb").metadata.error is not None
     assert store.get("p3_nb").metadata.finished is True
     assert store.get("p3_nb").metadata.error is None
+
+
+def test_build_multiclass_includes_gb(store, runtime, cfg):
+    """gb on a 3-class label is a real fit now (one-vs-rest over the
+    binary booster) — better than chance, pollable, normalized probs."""
+    rng = np.random.default_rng(1)
+    n = 600
+    x = rng.normal(size=n)
+    y2 = rng.normal(size=n)
+    lab = (x + 0.3 * rng.normal(size=n) > 0.5).astype(np.int64) \
+        + (x + 0.3 * rng.normal(size=n) > -0.5).astype(np.int64)
+    for name, sl in (("m3tr", slice(0, 500)), ("m3te", slice(500, None))):
+        store.create(name, columns={"x": x[sl], "y2": y2[sl],
+                                    "lab": lab[sl]}, finished=True)
+    mb = ModelBuilder(store, runtime, cfg)
+    reports = mb.build("m3tr", "m3te", "m3p", ["gb"], "lab",
+                       hparams={"gb": {"n_rounds": 5, "max_depth": 3}})
+    assert "error" not in reports[0].metrics, reports[0].metrics
+    assert reports[0].metrics["accuracy"] > 0.55
+    out = store.get("m3p_gb")
+    assert out.metadata.finished is True
+    row = out.rows(np.arange(1))[0]
+    assert len(row["probability"]) == 3
+    assert abs(sum(row["probability"]) - 1.0) < 1e-5
+
+
+def test_pipelined_build_matches_direct_sequential_fits(store, runtime, cfg):
+    """Determinism of the pipelined scheduler: the overlapped build's
+    prediction probabilities are identical to fitting each family
+    directly, sequentially, on the same design matrix (same seeds, same
+    programs — the scheduler must change WHEN things run, never what)."""
+    from learningorchestra_tpu.models.registry import get_trainer
+
+    _titanic_like(store, "ov_tr")
+    _titanic_like(store, "ov_te", n=100, seed=7)
+    cfg.max_concurrent_fits = 2
+    mb = ModelBuilder(store, runtime, cfg)
+    classifiers = ["lr", "nb", "dt"]
+    reports = mb.build("ov_tr", "ov_te", "ovp", classifiers, "Survived")
+    assert all("error" not in r.metrics for r in reports), reports
+    assert all(r.metrics.get("device_s", 0) > 0 for r in reports)
+
+    X, y, ff, state = design_matrix(store.get("ov_tr"), "Survived")
+    Xt, yt, _, _ = design_matrix(store.get("ov_te"), "Survived",
+                                 state=state, feature_fields=ff)
+    for c in classifiers:
+        model = get_trainer(c)(runtime, np.asarray(X, np.float32), y, 2)
+        want = model.predict_proba(runtime, np.asarray(Xt, np.float32))
+        got = np.stack(store.get(f"ovp_{c}").read_rows(
+            ["probability"], 0, 100)["probability"])
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                                   err_msg=c)
 
 
 def test_exec_preprocess_gated(store, runtime, cfg):

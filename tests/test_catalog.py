@@ -287,6 +287,66 @@ def test_value_counts_nulls(store):
     assert store.value_counts("n", "x") == {1.0: 2, 2.0: 1, None: 1}
 
 
+def test_value_counts_streams_without_consolidating(cfg):
+    """VERDICT r5 weak #7: value_counts on a spilled dataset must stream
+    chunk-by-chunk (single-field materializations, merged counts) and
+    never consolidate — it was the last O(dataset) read on the catalog
+    surface. Counts must equal the resident evaluation, including across
+    chunks whose dtypes differ before unification."""
+    import numpy as np
+
+    cfg.persist = True
+    cfg.ram_budget_mb = 1
+    store = DatasetStore(cfg)
+    ds = store.create("vc")
+    rng = np.random.default_rng(3)
+    n, chunk = 120_000, 8000
+    vals = rng.integers(0, 7, size=n)
+    for off in range(0, n, chunk):
+        ds.append_columns({"v": vals[off:off + chunk],
+                           "w": rng.normal(size=chunk)})
+    # One object chunk forces dtype unification (int keys must not split
+    # into int and str buckets across the chunk boundary).
+    ds.append_columns({
+        "v": np.array([3, "three", None], dtype=object),
+        "w": np.array([1.0, 2.0, np.nan])})
+    store.finish("vc")
+    assert ds.over_budget
+
+    from learningorchestra_tpu.catalog import dataset as dsmod
+
+    loads = []
+    orig_mat = dsmod._Chunk.materialize
+    orig_cons = dsmod.Dataset._consolidate_locked
+
+    def spy(self, fields=None):
+        loads.append(fields)
+        return orig_mat(self, fields)
+
+    def no_consolidate(self):
+        raise AssertionError("value_counts consolidated the dataset")
+
+    dsmod._Chunk.materialize = spy
+    dsmod.Dataset._consolidate_locked = no_consolidate
+    try:
+        out = store.value_counts("vc", "v")
+    finally:
+        dsmod._Chunk.materialize = orig_mat
+        dsmod.Dataset._consolidate_locked = orig_cons
+    expect = {int(k): int(c) for k, c in
+              zip(*np.unique(vals, return_counts=True))}
+    expect[3] += 1
+    expect["three"] = 1
+    expect[None] = 1
+    assert out == expect
+    # Streaming shape: one single-field materialization per chunk.
+    assert loads and all(f == ["v"] for f in loads)
+    assert len(loads) <= n // chunk + 1
+
+    with pytest.raises(KeyError):
+        store.value_counts("vc", "missing")
+
+
 def test_replica_failover_restores_catalog(tmp_path):
     """VERDICT r4 #4: losing the primary store_root entirely must be
     recoverable from the replica mirror (the reference's Mongo

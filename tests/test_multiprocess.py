@@ -26,6 +26,8 @@ _CHAOS_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "chaos_child.py")
 _ELASTIC_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "elastic_pod_child.py")
+_OVERLAP_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "overlap_child.py")
 
 
 def _free_port() -> int:
@@ -85,6 +87,91 @@ def test_two_process_model_build(tmp_path):
         str(v): (546 if v < 5 else 545) for v in range(11)}, result
     # Undispatched mesh ops refuse cleanly on a pod.
     assert result["guard"].startswith("refused"), result
+
+
+@pytest.mark.slow
+def test_pod_build_overlaps_fits(tmp_path):
+    """ISSUE 3 tentpole, pod side: a multi-classifier build runs as ONE
+    batched dispatch round — fit programs enqueued back-to-back, no host
+    barriers between families — so build wall-clock lands BELOW the sum
+    of its per-fit times (the spans overlap; the old serialized
+    one-fit-at-a-time loop made them disjoint, wall ≥ sum + dispatch
+    overhead). Slow-marked: a warm-up plus a measured 5-family round
+    over real cross-process gloo collectives takes minutes on CPU.
+
+    Also pins determinism: the pod's predictions must equal a
+    single-process build on the identical data bit-for-bit — both run
+    the same 8-device global mesh, and batching dispatch rounds must
+    change WHEN programs run, never what they compute."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _OVERLAP_CHILD, str(i), "2", str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("overlap pod deadlocked:\n"
+                    + "\n---\n".join(o or "" for o in outs))
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"process {i} failed:\n{outs[i]}"
+
+    with open(tmp_path / "overlap.json") as f:
+        result = json.load(f)
+    fams = result["families"]
+    assert all(doc["error"] is None for doc in fams.values()), fams
+    assert all(doc["device_s"] > 0 for doc in fams.values()), fams
+    # The overlap inequality itself.
+    sum_fit_s = sum(doc["fit_s"] for doc in fams.values())
+    assert result["wall_s"] < sum_fit_s, (result["wall_s"], fams)
+    # Within-rig determinism: two batched rounds on identical data
+    # produced bit-identical predictions (checked in the child).
+    assert result["repeatable"] is True
+
+    # Cross-rig determinism: same predictions as a single-process build
+    # on the same (seeded) data over the same 8-device global mesh — up
+    # to collective reduction order (gloo's 2-process ring sums in a
+    # different fp order than the single-host mesh; observed drift is
+    # ~1e-5, while a genuine program divergence would be orders larger).
+    import numpy as np
+
+    from learningorchestra_tpu.config import Settings
+    from learningorchestra_tpu.models.registry import get_trainer
+    from learningorchestra_tpu.ops.preprocess import design_matrix
+    from learningorchestra_tpu.parallel.mesh import MeshRuntime
+    from tests.overlap_data import CLASSIFIERS, HPARAMS, make_columns
+
+    from learningorchestra_tpu.catalog.store import DatasetStore
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "ref_store")
+    cfg.persist = False
+    ref_store = DatasetStore(cfg)
+    ref_store.create("rt", columns=make_columns(0, 20_000), finished=True)
+    ref_store.create("re", columns=make_columns(1, 2_000), finished=True)
+    runtime = MeshRuntime(cfg)
+    X, y, ff, state = design_matrix(ref_store.get("rt"), "label")
+    Xt, _, _, _ = design_matrix(ref_store.get("re"), "label",
+                                state=state, feature_fields=ff)
+    X = np.asarray(X, np.float32)
+    Xt = np.asarray(Xt, np.float32)
+    for c in CLASSIFIERS:
+        model = get_trainer(c)(runtime, X, y, 2, **HPARAMS.get(c, {}))
+        want = model.predict_proba(runtime, Xt)[:20]
+        got = np.asarray(result["probs"][c])
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=1e-4,
+                                   err_msg=c)
 
 
 def test_worker_death_mid_job_fails_pollably(tmp_path):

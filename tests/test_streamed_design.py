@@ -282,6 +282,103 @@ def test_streamed_build_never_consolidates(cfg, monkeypatch):
         assert set(np.unique(preds)) <= {0, 1}
 
 
+def _spy_fit_passes(monkeypatch):
+    """Count streaming passes (``_iter_blocks`` invocations) during a
+    fit — the scan-count the fused fitting passes exist to minimize."""
+    calls = []
+    orig = preprocess._iter_blocks
+
+    def spy(snap, n_rows, fields=None):
+        calls.append(fields)
+        return orig(snap, n_rows, fields)
+
+    monkeypatch.setattr(preprocess, "_iter_blocks", spy)
+    return calls
+
+
+def test_fused_fit_default_3step_pipeline_two_passes(store, monkeypatch):
+    """The acceptance pin: label_encode+fillna+standardize fits in ≤2
+    dataset scans (label_encode+fillna share the first; standardize —
+    whose stats read both steps' outputs — runs single-pass via per-block
+    moments + Chan merge), with numerics identical to the unfused
+    step-at-a-time oracle."""
+    ds = _fill_ds(store, "fu", n=2500, chunk=256, seed=8)
+    steps = [{"op": "label_encode"},
+             {"op": "fillna", "strategy": "mean"},
+             {"op": "standardize"}]
+    assert preprocess._fusion_groups(steps) == [[0, 1], [2]]
+    snap = ds.pin_snapshot()
+    oracle = preprocess._fit_design_state_unfused(
+        snap, ds.metadata.fields, "y", steps, ds.num_rows)
+    calls = _spy_fit_passes(monkeypatch)
+    prof = {}
+    fused = preprocess._fit_design_state(
+        snap, ds.metadata.fields, "y", steps, ds.num_rows, profile=prof)
+    assert prof["fit_passes"] == 2
+    assert len(calls) == 2
+    assert fused["0:label_encode"] == oracle["0:label_encode"]
+    for key in ("1:fillna", "2:standardize"):
+        assert set(fused[key]) == set(oracle[key])
+        for f, v in oracle[key].items():
+            np.testing.assert_allclose(
+                np.asarray(fused[key][f], np.float64),
+                np.asarray(v, np.float64), rtol=1e-9, atol=1e-12)
+
+
+def test_fused_fit_default_pipeline_single_pass(store, monkeypatch):
+    """The default pipeline (label_encode+fillna) — plus the label vocab,
+    which rides the first pass — fits in ONE scan (was 3)."""
+    ds = _fill_ds(store, "fu1", n=1500, chunk=256, seed=9)
+    # Object label so the vocab fit is actually exercised.
+    ds2 = store.create("fu1s")
+    cats = np.array(["x", "y", "z"], dtype=object)
+    rng = np.random.default_rng(0)
+    num = rng.normal(size=900)
+    num[rng.random(900) < 0.1] = np.nan
+    for off in range(0, 900, 300):
+        ds2.append_columns({
+            "num": num[off:off + 300],
+            "cat": cats[rng.integers(0, 3, 300)],
+            "y": cats[rng.integers(0, 3, 300)],
+        })
+    store.finish("fu1s")
+    steps = [dict(s) for s in preprocess._DEFAULT_STEPS]
+    snap = ds2.pin_snapshot()
+    oracle = preprocess._fit_design_state_unfused(
+        snap, ds2.metadata.fields, "y", steps, ds2.num_rows)
+    calls = _spy_fit_passes(monkeypatch)
+    prof = {}
+    fused = preprocess._fit_design_state(
+        snap, ds2.metadata.fields, "y", steps, ds2.num_rows, profile=prof)
+    assert prof["fit_passes"] == 1
+    assert len(calls) == 1
+    assert fused["__label_vocab__"] == oracle["__label_vocab__"]
+    assert fused["0:label_encode"] == oracle["0:label_encode"]
+    for f, v in oracle["1:fillna"].items():
+        np.testing.assert_allclose(fused["1:fillna"][f], v, rtol=1e-9)
+
+
+def test_fused_fit_dependent_steps_split_passes(store, monkeypatch):
+    """Dependency rules: fillna→fillna and cast barriers split groups;
+    the grouped fit still matches the oracle."""
+    steps = [{"op": "fillna", "strategy": "mean"},
+             {"op": "fillna", "strategy": "zero"}]
+    assert preprocess._fusion_groups(steps) == [[0], [1]]
+    steps_b = [{"op": "label_encode"},
+               {"op": "cast", "fields": ["intc"], "dtype": "float32"},
+               {"op": "fillna", "strategy": "mean"}]
+    assert preprocess._fusion_groups(steps_b) == [[0], [2]]
+    ds = _fill_ds(store, "fu2", n=1200, chunk=200, seed=10)
+    snap = ds.pin_snapshot()
+    oracle = preprocess._fit_design_state_unfused(
+        snap, ds.metadata.fields, "y", steps_b, ds.num_rows)
+    fused = preprocess._fit_design_state(
+        snap, ds.metadata.fields, "y", steps_b, ds.num_rows)
+    assert fused["0:label_encode"] == oracle["0:label_encode"]
+    for f, v in oracle["2:fillna"].items():
+        np.testing.assert_allclose(fused["2:fillna"][f], v, rtol=1e-9)
+
+
 def test_streamed_lr_matches_resident_lr(store, runtime):
     """Same trainer, same seed: the streamed design must produce the same
     model as the resident matrix (identical probabilities)."""
