@@ -9,5 +9,6 @@
 import pyarrow  # noqa: F401
 import pyarrow.parquet  # noqa: F401
 
-from learningorchestra_tpu.catalog.dataset import Dataset, Metadata  # noqa: F401,E402
+from learningorchestra_tpu.catalog.dataset import (  # noqa: F401,E402
+    ChunkCorrupt, Dataset, Metadata)
 from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: F401,E402

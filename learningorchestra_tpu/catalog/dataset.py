@@ -38,13 +38,57 @@ import os
 import threading
 import time
 import weakref
+import zlib
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from learningorchestra_tpu.utils import failpoints
+
 #: Columns are numpy arrays: numeric dtypes or ``object`` for strings/mixed.
 Columns = Dict[str, np.ndarray]
+
+#: Deterministic fault-injection sites (utils/failpoints.py). Each names
+#: the exact I/O boundary a crash/torn-write test targets; zero overhead
+#: unless armed via LO_TPU_FAILPOINTS.
+FP_WRITE_CHUNK_PRE_RENAME = failpoints.declare(
+    "catalog.write_chunk.pre_rename")
+FP_JOURNAL_MID_APPEND = failpoints.declare("catalog.journal.mid_append")
+FP_JOURNAL_PRE_SWAP = failpoints.declare("catalog.journal.pre_swap")
+FP_CHUNK_PRE_READ = failpoints.declare("catalog.chunk.pre_read")
+
+
+class ChunkCorrupt(RuntimeError):
+    """A journaled chunk file failed its checksum (or vanished) and could
+    not be repaired from the replica mirror — the precise,
+    catalog-surface error that replaces an opaque parquet/arrow parse
+    traceback deep inside a consumer."""
+
+    def __init__(self, path: str, expected: Optional[int],
+                 actual: Optional[int]):
+        self.path = path
+        self.expected = expected
+        self.actual = actual
+        what = ("is missing" if actual is None else
+                f"checksum mismatch (journal crc32={expected}, "
+                f"file crc32={actual})")
+        super().__init__(
+            f"chunk file {path} {what}; the dataset's journaled data is "
+            "corrupt and no valid replica copy was available to repair "
+            "from (see DatasetStore.scrub / docs/fault_tolerance.md)")
+
+
+def crc32_file(path: str) -> int:
+    """Streaming CRC32 of a file's bytes — the per-chunk integrity
+    checksum recorded in the journal and verified on read/scrub."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(block, crc)
 
 
 @dataclass
@@ -125,7 +169,7 @@ class _Chunk:
     """
 
     __slots__ = ("cols", "arrow", "path", "n_rows", "dtypes", "data_bytes",
-                 "src_off", "_evictable")
+                 "src_off", "_evictable", "crc32", "verify", "_verified")
 
     def __init__(self, cols: Columns):
         self.cols: Optional[Columns] = cols
@@ -137,6 +181,13 @@ class _Chunk:
         self.data_bytes = sum(_arr_bytes(a) for a in cols.values())
         self.src_off: Optional[int] = None
         self._evictable: Optional[bool] = None
+        #: Journaled CRC32 of the chunk file's bytes (None for chunks
+        #: never flushed, or restored from pre-checksum journals).
+        self.crc32: Optional[int] = None
+        #: Integrity callback (Dataset._verify_chunk) run before the
+        #: first disk read of this chunk; None for purely in-memory use.
+        self.verify: Optional[Callable] = None
+        self._verified = False
 
     @classmethod
     def from_arrow(cls, batch, src_off: Optional[int] = None) -> "_Chunk":
@@ -147,6 +198,9 @@ class _Chunk:
         c.cols = None
         c.arrow = batch
         c.path = None
+        c.crc32 = None
+        c.verify = None
+        c._verified = False
         c.n_rows = batch.num_rows
         c.dtypes = {}
         for fld in batch.schema:
@@ -164,8 +218,8 @@ class _Chunk:
 
     @classmethod
     def on_disk(cls, path: str, n_rows: int, dtypes: Dict[str, np.dtype],
-                data_bytes: int,
-                src_off: Optional[int] = None) -> "_Chunk":
+                data_bytes: int, src_off: Optional[int] = None,
+                crc32: Optional[int] = None) -> "_Chunk":
         """Handle for a journaled chunk file — no data read (lazy load)."""
         c = cls.__new__(cls)
         c.cols = None
@@ -176,6 +230,9 @@ class _Chunk:
         c.data_bytes = data_bytes
         c.src_off = src_off
         c._evictable = True
+        c.crc32 = crc32
+        c.verify = None
+        c._verified = False
         return c
 
     @property
@@ -228,6 +285,12 @@ class _Chunk:
                         if fields is None or name in fields}
                 return ({f: data[f] for f in fields} if fields is not None
                         else data)
+            if not self._verified and self.verify is not None:
+                # First disk read: checksum the file (repairing from the
+                # replica on mismatch) before handing bytes to the arrow
+                # reader — corruption surfaces as ChunkCorrupt here, not
+                # as a parse traceback deep inside a fit.
+                self.verify(self)
             data = read_chunk_file(self.path, fields)
             for f, a in data.items():
                 want = self.dtypes.get(f)
@@ -279,6 +342,12 @@ class Dataset:
         #: describe the data and the store must rewrite a fresh generation
         #: on the next save.
         self._rewrite_needed = False
+        #: ``hook(chunk_basename, expected_crc) -> bool`` — attempts to
+        #: restore a corrupt/missing chunk file (DatasetStore wires this
+        #: to its replica mirror). None = no repair tier; corruption
+        #: raises ChunkCorrupt directly.
+        self._repair_hook: Optional[Callable[[str, Optional[int]], bool]] \
+            = None
         if columns:
             self.append_columns(columns)
 
@@ -293,6 +362,47 @@ class Dataset:
             self._journal_path = journal_path
             self._ram_budget = ram_budget_bytes or None
             self._maybe_evict_locked()
+
+    def set_repair_hook(self, hook: Optional[Callable]) -> None:
+        """Wire the corruption-repair tier (``hook(basename, crc) ->
+        repaired?``) — called by DatasetStore with its replica mirror."""
+        self._repair_hook = hook
+
+    def _verify_chunk(self, chunk: "_Chunk") -> None:
+        """Checksum one on-disk chunk before its bytes are trusted.
+
+        Fires the ``catalog.chunk.pre_read`` failpoint (the bit-rot
+        injection site), then compares the file's CRC32 against the
+        journaled value. On mismatch — or a missing file — the repair
+        hook (replica mirror) gets one shot at restoring it; if the file
+        still doesn't verify, raises :class:`ChunkCorrupt`. Chunks from
+        pre-checksum journals (``crc32`` is None) have nothing to verify
+        and pass. Idempotent and safe to race: repair lands via
+        tmp+rename, and the worst case is two threads both verifying.
+        """
+        failpoints.fire(FP_CHUNK_PRE_READ, path=chunk.path)
+        expected = chunk.crc32
+        if expected is None:
+            chunk._verified = os.path.isfile(chunk.path)
+            if not chunk._verified:
+                if self._repair_hook is not None and self._repair_hook(
+                        os.path.basename(chunk.path), None):
+                    chunk._verified = True
+                    return
+                raise ChunkCorrupt(chunk.path, None, None)
+            return
+        actual = (crc32_file(chunk.path) if os.path.isfile(chunk.path)
+                  else None)
+        if actual == expected:
+            chunk._verified = True
+            return
+        if self._repair_hook is not None and self._repair_hook(
+                os.path.basename(chunk.path), expected):
+            if os.path.isfile(chunk.path) \
+                    and crc32_file(chunk.path) == expected:
+                chunk._verified = True
+                return
+        raise ChunkCorrupt(chunk.path, expected, actual)
 
     @property
     def mem_bytes(self) -> int:
@@ -429,12 +539,21 @@ class Dataset:
             # promoted a view's dtype past what the chunk was appended
             # with).
             dtypes = {f: str(a.dtype) for f, a in cols.items()}
+        # Checksum BEFORE the durability barrier: the journaled CRC32
+        # describes what the writer intended, so storage-level damage
+        # after this point (torn write, bit rot — or the failpoint below
+        # simulating either) is detectable on every later read/scrub.
+        crc = crc32_file(tmp)
         _fsync_file(tmp)
+        failpoints.fire(FP_WRITE_CHUNK_PRE_RENAME, path=tmp)
         os.replace(tmp, final)
         _fsync_dir(self._chunk_dir)
         chunk.path = final
+        chunk.crc32 = crc
+        chunk.verify = self._verify_chunk
+        chunk._verified = False
         rec = {"file": fname, "rows": chunk.n_rows,
-               "bytes": chunk.data_bytes, "dtypes": dtypes}
+               "bytes": chunk.data_bytes, "dtypes": dtypes, "crc32": crc}
         if chunk.src_off is not None:
             rec["src_off"] = chunk.src_off
         return rec
@@ -452,6 +571,10 @@ class Dataset:
             for rec in records:
                 f.write(json.dumps(rec) + "\n")
             f.flush()
+            # Crash window under test: records written but not yet
+            # durable — recovery must land on the journaled prefix
+            # (_parse_journal_bytes tolerates a torn tail).
+            failpoints.fire(FP_JOURNAL_MID_APPEND, path=self._journal_path)
             os.fsync(f.fileno())
         self._journal_records += len(records)
 
@@ -504,6 +627,10 @@ class Dataset:
                 f.write(json.dumps(rec) + "\n")
             f.flush()
             os.fsync(f.fileno())
+        # Crash window under test: new-generation files durable, old
+        # journal still in place — whichever journal survives references
+        # files that exist.
+        failpoints.fire(FP_JOURNAL_PRE_SWAP, path=tmp)
         os.replace(tmp, self._journal_path)
         _fsync_dir(os.path.dirname(self._journal_path))
         self._journal_records = len(records)
@@ -631,9 +758,12 @@ class Dataset:
         max_gen, max_id = 0, -1
         for rec in records:
             dtypes = {f: np.dtype(dt) for f, dt in rec["dtypes"].items()}
-            chunks.append(_Chunk.on_disk(
+            c = _Chunk.on_disk(
                 os.path.join(chunk_dir, rec["file"]), rec["rows"], dtypes,
-                rec.get("bytes", 0), src_off=rec.get("src_off")))
+                rec.get("bytes", 0), src_off=rec.get("src_off"),
+                crc32=rec.get("crc32"))
+            c.verify = self._verify_chunk
+            chunks.append(c)
             gen, cid = _parse_chunk_name(rec["file"])
             if (gen, cid) > (max_gen, max_id):
                 max_gen, max_id = gen, cid
@@ -647,6 +777,40 @@ class Dataset:
             self._chunk_dir = chunk_dir
             self._gc_locked()
             self._chunk_dir = prev_dir
+
+    def scrub_chunks(self) -> Dict[str, Any]:
+        """Eagerly re-verify every journaled chunk file's checksum (the
+        proactive integrity pass behind ``DatasetStore.scrub`` /
+        ``POST /catalog/scrub``). Ignores the lazy ``_verified`` flag —
+        a scrub re-reads every file so rot that set in *after* first
+        read is still caught. Repair (replica mirror) runs exactly as on
+        the lazy path; unrepairable chunks are reported, not raised, so
+        one corrupt dataset doesn't abort a catalog-wide scrub."""
+        with self._data_lock:
+            chunks = [c for c in self._chunks if c.path is not None]
+            # Register as an active reader for the pass: a concurrent
+            # generation rewrite (set_column save / budget eviction)
+            # must not GC this snapshot's files mid-verification —
+            # deleted-under-us files would read as false corruption.
+            self._active_readers += 1
+        report: Dict[str, Any] = {"checked": 0, "unchecksummed": 0,
+                                  "errors": []}
+        try:
+            for c in chunks:
+                if c.crc32 is None and os.path.isfile(c.path):
+                    # Pre-checksum journal record: existence is all we
+                    # can attest.
+                    report["unchecksummed"] += 1
+                    continue
+                c._verified = False
+                try:
+                    self._verify_chunk(c)
+                    report["checked"] += 1
+                except ChunkCorrupt as exc:
+                    report["errors"].append(str(exc))
+        finally:
+            self._release_reader()
+        return report
 
     # -- reads --------------------------------------------------------------
 

@@ -46,6 +46,12 @@ import numpy as np
 
 from learningorchestra_tpu.catalog.store import DatasetStore
 from learningorchestra_tpu.config import settings as global_settings
+from learningorchestra_tpu.utils import failpoints
+
+#: Deterministic fault-injection site: fires after each source byte
+#: chunk lands in the split buffer — the mid-download crash window an
+#: ingest resume must survive (utils/failpoints.py).
+FP_BLOCK_POST_FETCH = failpoints.declare("ingest.block.post_fetch")
 
 
 class InvalidCsvUrl(ValueError):
@@ -408,6 +414,7 @@ def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
         if isinstance(item, Exception):
             raise item
         buf.extend(item)
+        failpoints.fire(FP_BLOCK_POST_FETCH)
         return True
 
     # -- header (fresh ingest only): first record names the columns -------

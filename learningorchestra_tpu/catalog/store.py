@@ -33,8 +33,14 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from learningorchestra_tpu.catalog.dataset import (
-    Columns, Dataset, Metadata, rows_from as _rows_from)
+    ChunkCorrupt, Columns, Dataset, Metadata, _fsync_dir, crc32_file,
+    rows_from as _rows_from)
 from learningorchestra_tpu.config import Settings, settings as global_settings
+from learningorchestra_tpu.utils import failpoints
+
+#: Deterministic fault-injection sites (utils/failpoints.py).
+FP_MIRROR_PRE_COPY = failpoints.declare("store.mirror.pre_copy")
+FP_FINISH_PRE_SAVE = failpoints.declare("store.finish.pre_save")
 
 
 class DatasetNotFound(KeyError):
@@ -146,6 +152,21 @@ class DatasetStore:
         #: Interrupted source-URL ingests found by the last load_all
         #: (resume_ingests=True) — the serving layer resubmits these.
         self.resumable_ingests: List[str] = []
+        #: Data-plane integrity counters, served on GET /metrics:
+        #: corrupt chunk detections, successful replica repairs, and
+        #: scrub activity.
+        self._integrity_lock = threading.Lock()
+        self._integrity = {"chunks_corrupt": 0, "chunks_repaired": 0,
+                           "chunks_scrubbed": 0, "scrub_runs": 0}
+
+    def _bump(self, key: str, by: int = 1) -> None:
+        with self._integrity_lock:
+            self._integrity[key] += by
+
+    def integrity_snapshot(self) -> Dict[str, int]:
+        """Corruption/repair counters (GET /metrics ``integrity`` block)."""
+        with self._integrity_lock:
+            return dict(self._integrity)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -226,6 +247,7 @@ class DatasetStore:
                 f"({ds.metadata.error}); refusing to mark it finished")
         ds.metadata.extra.update(extra)
         ds.metadata.finished = True
+        failpoints.fire(FP_FINISH_PRE_SAVE)
         if self.cfg.persist:
             self.save(name)
 
@@ -432,6 +454,58 @@ class DatasetStore:
         ds.attach_storage(os.path.join(path, "chunks"),
                           os.path.join(path, "journal.jsonl"),
                           ram_budget_bytes=budget)
+        name = ds.metadata.name
+        ds.set_repair_hook(
+            lambda fname, crc, _n=name: self._repair_chunk(_n, fname, crc))
+
+    def _repair_chunk(self, name: str, fname: str,
+                      expected_crc: Optional[int]) -> bool:
+        """A chunk file failed verification (checksum mismatch / missing)
+        — the self-healing tier. Counts the detection, then restores the
+        file from the replica mirror when one is configured AND its copy
+        itself verifies (a replica that mirrored the same rot must not
+        'repair' corrupt bytes over corrupt bytes). The restore lands via
+        tmp+rename so a concurrent reader never sees a half-copied file.
+        Returns whether a verified copy was installed."""
+        self._bump("chunks_corrupt")
+        if not self.cfg.replica_root:
+            return False
+        src = os.path.join(self.cfg.replica_root, name, "chunks", fname)
+        if not os.path.isfile(src):
+            return False
+        if expected_crc is not None and crc32_file(src) != expected_crc:
+            return False
+        dst_dir = os.path.join(self.cfg.store_root, name, "chunks")
+        os.makedirs(dst_dir, exist_ok=True)
+        dst = os.path.join(dst_dir, fname)
+        tmp = dst + ".repair"
+        shutil.copy2(src, tmp)
+        os.replace(tmp, dst)
+        _fsync_dir(dst_dir)
+        self._bump("chunks_repaired")
+        return True
+
+    def scrub(self, name: Optional[str] = None) -> Dict[str, Any]:
+        """Proactive integrity pass: re-verify every journaled chunk's
+        checksum for one dataset (or the whole catalog), repairing from
+        the replica where possible. Returns a report; corruption that
+        could not be repaired is listed per dataset under ``errors``
+        rather than raised, so one rotten dataset doesn't hide the state
+        of the rest. Served at ``POST /catalog/scrub``."""
+        names = [name] if name else self.names()
+        report: Dict[str, Any] = {"datasets": len(names), "checked": 0,
+                                  "unchecksummed": 0, "errors": {}}
+        for n in names:
+            ds = self.get(n)
+            r = ds.scrub_chunks()
+            report["checked"] += r["checked"]
+            report["unchecksummed"] += r["unchecksummed"]
+            if r["errors"]:
+                report["errors"][n] = r["errors"]
+        self._bump("chunks_scrubbed", report["checked"])
+        self._bump("scrub_runs")
+        report["ok"] = not report["errors"]
+        return report
 
     def save(self, name: str) -> None:
         """Incremental commit: flush new chunks + rewrite metadata.json.
@@ -495,8 +569,23 @@ class DatasetStore:
                     continue
                 s = os.path.join(src_chunks, fn)
                 d = os.path.join(dst, "chunks", fn)
-                if os.path.isfile(s) and not os.path.isfile(d):
-                    shutil.copy2(s, d)
+                if os.path.isfile(d):
+                    continue
+                failpoints.fire(FP_MIRROR_PRE_COPY, path=s)
+                if not os.path.isfile(s):
+                    continue
+                crc = rec.get("crc32")
+                actual = None if crc is None else crc32_file(s)
+                if crc is not None and actual != crc:
+                    # The primary file is already damaged at mirror time
+                    # (torn write that slipped past rename, or rot
+                    # between commit and mirror). NEVER propagate corrupt
+                    # bytes into the replica: repair the primary from an
+                    # existing good replica copy if one survives,
+                    # otherwise fail the save with the precise error.
+                    if not self._repair_chunk(name, fn, crc):
+                        raise ChunkCorrupt(s, crc, actual)
+                shutil.copy2(s, d)
 
         # One atomic snapshot under the dataset's data lock: a concurrent
         # eviction flush (journal append) or inline generation rewrite
@@ -567,6 +656,13 @@ class DatasetStore:
         ds = Dataset(meta)
         if records:
             ds.restore_chunks(records, os.path.join(path, "chunks"))
+            if not meta.fields:
+                # Crash window: chunks journal-committed before the first
+                # metadata rewrite landed (save orders journal first).
+                # The journal's dtype maps carry the field names in
+                # append order — recover them so the prefix is readable
+                # (and a resumed ingest knows its columns).
+                meta.fields = list(records[0].get("dtypes", {}).keys())
         else:
             data_path = os.path.join(path, "data.parquet")
             if os.path.isfile(data_path):
@@ -631,6 +727,37 @@ class DatasetStore:
                     self.resumable_ingests.append(name)
                     continue
                 self.fail(name, "interrupted: server restarted mid-job")
+        if self.cfg.scrub_on_load and loaded:
+            # Recovery-scan verification: checksum every journaled chunk
+            # the crash-surviving journals reference, repairing from the
+            # replica where possible. Off by default — it reads every
+            # chunk file, trading startup time for eager detection;
+            # lazy first-read verification covers the default path.
+            report = self.scrub()
+            for n, errs in report["errors"].items():
+                # Direct mark (not ``fail``): corruption must surface on
+                # the metadata even for datasets that finished
+                # successfully before the rot set in, and must not
+                # overwrite an earlier recorded root cause.
+                ds = self.get(n)
+                ds.metadata.error = (ds.metadata.error
+                                     or f"chunk corruption: {errs[0]}")
+                ds.metadata.finished = True
+                # A corrupt interrupted ingest must NOT be resubmitted
+                # for resume — it would append fresh rows to a dataset
+                # just declared damaged.
+                if n in self.resumable_ingests:
+                    self.resumable_ingests.remove(n)
+                if self.cfg.persist:
+                    try:
+                        self.save(n)
+                    except ChunkCorrupt:
+                        # The mirror re-verifies chunks and re-raises on
+                        # the same unrepairable file; metadata.json was
+                        # already rewritten before the mirror step, and
+                        # one rotten dataset must not abort the whole
+                        # recovery scan.
+                        pass
         return loaded
 
 

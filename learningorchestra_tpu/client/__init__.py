@@ -19,7 +19,9 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import json
+import random
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
 import requests
@@ -37,54 +39,90 @@ class Context:
     ``timeout`` bounds job polling (and the synchronous model build, which
     legitimately runs for the whole fit); ``request_timeout`` bounds every
     other HTTP call so a hung server can never hang the client forever.
-    Connection errors on idempotent calls (GET/DELETE) retry with
-    exponential backoff; POSTs never auto-retry (a retried create whose
-    first attempt actually landed would surface as a spurious 409).
+    Connection errors and 503s (pod mid-recovery) retry with capped,
+    full-jitter exponential backoff on every method: GET/DELETE are
+    idempotent by nature, and POSTs carry an ``Idempotency-Key`` header
+    the server dedupes on, so a retried create whose first attempt
+    actually landed replays the original response instead of surfacing a
+    spurious 409 (this closes the old "POSTs never auto-retry" carve-out).
 
-    A 503 answer (the pod is degraded; its supervisor is restarting it
-    under a new mesh epoch) retries idempotent calls too, honoring the
-    server's ``Retry-After`` hint — a pod mid-recovery looks like a slow
-    request, not an error, exactly as a Swarm-restarted reference service
-    would.
+    Backoff discipline (every sleep is bounded):
+    - per-attempt sleep is ``uniform(0, min(backoff_cap, base * 2^n))``
+      (full jitter — a fleet of clients retrying a recovering pod must
+      not stampede it in lockstep);
+    - a server ``Retry-After`` hint is honored but clamped to
+      ``retry_after_cap`` (a confused server must not park clients for
+      an hour);
+    - cumulative sleep across one logical request never exceeds
+      ``max_retry_wait``: past it, the last response/error is returned/
+      raised even if retries remain.
     """
 
     def __init__(self, base_url: str, poll_seconds: float =
                  DEFAULT_POLL_SECONDS, timeout: float = 600.0,
                  request_timeout: float = 30.0, retries: int = 3,
-                 backoff_seconds: float = 0.5):
+                 backoff_seconds: float = 0.5,
+                 backoff_cap_seconds: float = 15.0,
+                 retry_after_cap: float = 30.0,
+                 max_retry_wait: float = 120.0):
         self.base_url = base_url.rstrip("/")
         self.poll_seconds = poll_seconds
         self.timeout = timeout
         self.request_timeout = request_timeout
         self.retries = retries
         self.backoff_seconds = backoff_seconds
+        self.backoff_cap_seconds = backoff_cap_seconds
+        self.retry_after_cap = retry_after_cap
+        self.max_retry_wait = max_retry_wait
 
     def url(self, path: str) -> str:
         return f"{self.base_url}{path}"
 
+    def _backoff(self, attempt: int) -> float:
+        return random.uniform(0.0, min(self.backoff_cap_seconds,
+                                       self.backoff_seconds * (2 ** attempt)))
+
     def request(self, method: str, path: str,
                 timeout: Optional[float] = None, **kwargs):
         deadline = timeout if timeout is not None else self.request_timeout
-        retries = self.retries if method.upper() in ("GET", "DELETE") else 0
+        retries = self.retries
+        if method.upper() == "POST":
+            # One key per LOGICAL create, shared by all its retries: the
+            # server replays the first landed attempt's response.
+            headers = dict(kwargs.pop("headers", None) or {})
+            headers.setdefault("Idempotency-Key", uuid.uuid4().hex)
+            kwargs["headers"] = headers
         attempt = 0
+        slept = 0.0
+
+        def sleep(wait: float) -> bool:
+            """Sleep within the total-wait budget; False = budget spent."""
+            nonlocal slept
+            wait = min(wait, max(0.0, self.max_retry_wait - slept))
+            if wait <= 0 and slept >= self.max_retry_wait:
+                return False
+            time.sleep(wait)
+            slept += wait
+            return True
+
         while True:
             try:
                 resp = requests.request(method, self.url(path),
                                         timeout=deadline, **kwargs)
             except requests.ConnectionError:
-                if attempt >= retries:
+                if attempt >= retries or not sleep(self._backoff(attempt)):
                     raise
-                time.sleep(self.backoff_seconds * (2 ** attempt))
                 attempt += 1
                 continue
             if resp.status_code == 503 and attempt < retries:
                 # Pod mid-recovery (supervisor restart): honor the
-                # server's backoff hint and retry.
+                # server's backoff hint, clamped.
                 try:
                     wait = float(resp.headers.get("Retry-After", ""))
                 except ValueError:
-                    wait = self.backoff_seconds * (2 ** attempt)
-                time.sleep(wait)
+                    wait = self._backoff(attempt)
+                if not sleep(min(max(wait, 0.0), self.retry_after_cap)):
+                    return resp
                 attempt += 1
                 continue
             return resp

@@ -61,6 +61,15 @@ class Settings:
     replica_root: str = field(
         default_factory=lambda: _env("LO_TPU_REPLICA_ROOT", "")
     )
+    #: Run a full checksum scrub (DatasetStore.scrub) as part of
+    #: load_all's recovery scan: every journaled chunk file is re-read
+    #: and verified against its journal CRC32, repairing from the
+    #: replica on mismatch. Off by default — it reads every chunk at
+    #: startup; the lazy first-read verification covers the default
+    #: path, and POST /catalog/scrub runs the same pass on demand.
+    scrub_on_load: bool = field(
+        default_factory=lambda: _env("LO_TPU_SCRUB_ON_LOAD", False, bool)
+    )
 
     # --- ingestion ---------------------------------------------------------
     #: CSV ingest chunk size (rows) for the streaming loader. Replaces the
@@ -121,6 +130,14 @@ class Settings:
     #: Page-size cap for dataset reads; reference hard-caps at 20
     #: (database_api_image/server.py:28,69-70).
     read_limit_cap: int = field(default_factory=lambda: _env("LO_TPU_READ_CAP", 20))
+    #: Per-connection socket timeout (seconds) on the HTTP server. A
+    #: handler thread reading a request body blocks on the client's
+    #: socket; without a timeout a hung/dead client that sent a
+    #: Content-Length it never delivers wedges that thread forever.
+    #: 0 disables (not recommended outside tests).
+    http_timeout_s: float = field(
+        default_factory=lambda: _env("LO_TPU_HTTP_TIMEOUT_S", 30.0)
+    )
     #: Directory where viz services write PNGs (reference volumes
     #: tsne:/images, pca:/images, docker-compose.yml:289-290).
     image_root: str = field(

@@ -40,7 +40,14 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from learningorchestra_tpu.utils import failpoints
+
 log = logging.getLogger("lo_tpu.spmd")
+
+#: Deterministic fault-injection site: process 0, every worker ready,
+#: about to release them with 'go' — the dispatch-side crash window the
+#: watchdog + supervisor recovery path must survive (utils/failpoints.py).
+FP_DISPATCH_PRE_GO = failpoints.declare("spmd.dispatch.pre_go")
 
 
 class PodDegraded(RuntimeError):
@@ -307,6 +314,7 @@ class _JobChannel:
             raise RuntimeError(
                 f"SPMD dispatch aborted ({len(failures)} worker(s)): "
                 + "; ".join(failures[:3]))
+        failpoints.fire(FP_DISPATCH_PRE_GO)
         self._sendall(conns, {"op": "go", "round": rnd})
 
     def broadcast(self, msg: Dict[str, Any]) -> None:

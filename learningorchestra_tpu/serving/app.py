@@ -22,6 +22,7 @@ import logging
 import os
 from typing import Optional
 
+from learningorchestra_tpu.catalog.dataset import ChunkCorrupt
 from learningorchestra_tpu.catalog.ingest import ingest_csv_url
 from learningorchestra_tpu.catalog.store import (
     DatasetExists, DatasetNotFound, DatasetStore)
@@ -34,7 +35,7 @@ from learningorchestra_tpu.ops.projection import create_projection
 from learningorchestra_tpu.parallel import distributed, spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
 from learningorchestra_tpu.serving.http import (
-    FileResponse, HtmlResponse, HttpError, Router, Server)
+    FileResponse, HtmlResponse, HttpError, IdempotencyCache, Router, Server)
 from learningorchestra_tpu.viz.service import (
     ImageExists, ImageNotFound, ImageService, create_embedding_image)
 
@@ -62,6 +63,10 @@ class App:
                                                   self.cfg))
         self.builder = ModelBuilder(self.store, self.runtime, self.cfg)
         self.images = {m: ImageService(m, self.cfg) for m in ("tsne", "pca")}
+        #: POST replay cache: a create retried with the same
+        #: Idempotency-Key (the client SDK sends one per logical create)
+        #: returns the first attempt's outcome instead of a spurious 409.
+        self.idempotency = IdempotencyCache()
         self.router = Router()
         self._register()
         if recover and self.cfg.persist:
@@ -75,11 +80,21 @@ class App:
     # -- helpers -------------------------------------------------------------
 
     def _wrap(self, fn):
-        """Translate domain exceptions to the reference's status codes."""
+        """Translate domain exceptions to the reference's status codes.
 
-        def inner(req):
+        The conversion runs INSIDE the idempotency replay boundary: a
+        duplicate create replays the first attempt's mapped status
+        (e.g. 409), never a generic 500 wrapper around the raw domain
+        exception.
+        """
+
+        def convert(req):
             try:
                 return fn(req)
+            except ChunkCorrupt as e:
+                # Integrity failure the replica couldn't heal: a precise
+                # 500 naming the chunk/checksums, not a parse traceback.
+                raise HttpError(500, str(e))
             except spmd.PodDegraded as e:
                 # A degraded pod is mid-recovery (its supervisor restarts
                 # it under a new mesh epoch): answer 503 + Retry-After so
@@ -98,6 +113,16 @@ class App:
                 raise HttpError(403, str(e))
             except ValueError as e:
                 raise HttpError(406, str(e))
+
+        def inner(req):
+            if req.method == "POST":
+                key = req.header("Idempotency-Key")
+                # Key scoped per path: a client reusing one key against a
+                # different endpoint must not replay the wrong response.
+                return self.idempotency.run(
+                    f"{req.path}|{key}" if key else None,
+                    lambda: convert(req))
+            return convert(req)
 
         return inner
 
@@ -292,6 +317,18 @@ class App:
         for method in ("tsne", "pca"):
             self._register_images(method)
 
+        # ---- catalog administration
+        @self._route("POST", "/catalog/scrub")
+        def catalog_scrub(req):
+            # Proactive integrity pass over the journaled chunk store:
+            # verify every chunk checksum, repair from the replica where
+            # possible, report what couldn't be healed. Synchronous by
+            # design — an admin operation whose caller wants the verdict.
+            name = req.body.get("dataset")
+            if name is not None and not app.store.exists(name):
+                raise DatasetNotFound(name)
+            return 200, app.store.scrub(name)
+
         # ---- observability (upgrade; reference exposed Spark UIs only)
         @self._route("GET", "/cluster")
         def cluster(_req):
@@ -335,6 +372,7 @@ class App:
                 by_status[r["status"]] = by_status.get(r["status"], 0) + 1
             return 200, {"ops": op_timer.snapshot(),
                          "jobs": by_status,
+                         "integrity": app.store.integrity_snapshot(),
                          "profile_dir": app.cfg.profile_dir or None}
 
     def _register_images(self, method: str) -> None:
@@ -463,7 +501,8 @@ class App:
     # -- lifecycle -----------------------------------------------------------
 
     def serve(self, background: bool = False) -> Server:
-        server = Server(self.router, self.cfg.host, self.cfg.port)
+        server = Server(self.router, self.cfg.host, self.cfg.port,
+                        request_timeout_s=self.cfg.http_timeout_s)
         if background:
             return server.start_background()
         server.serve_forever()

@@ -11,8 +11,10 @@ server.py:52-115) — in ~150 lines with no third-party dependency, served by
 
 from __future__ import annotations
 
+import collections
 import json
 import re
+import socket
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -34,12 +36,19 @@ class HttpError(Exception):
 
 class Request:
     def __init__(self, method: str, path: str, params: Dict[str, str],
-                 query: Dict[str, List[str]], body: Optional[Dict[str, Any]]):
+                 query: Dict[str, List[str]], body: Optional[Dict[str, Any]],
+                 headers: Optional[Dict[str, str]] = None):
         self.method = method
         self.path = path
         self.params = params
         self.query = query
         self.body = body or {}
+        #: Request headers, case-insensitively readable via ``header()``.
+        self.headers = dict(headers or {})
+        self._headers_lower = {k.lower(): v for k, v in self.headers.items()}
+
+    def header(self, name: str, default: Optional[str] = None):
+        return self._headers_lower.get(name.lower(), default)
 
     def q(self, name: str, default=None, cast=None):
         vals = self.query.get(name)
@@ -87,8 +96,8 @@ class Router:
 
         return deco
 
-    def dispatch(self, req_method: str, url: str,
-                 body: Optional[Dict]) -> Tuple[int, Any]:
+    def dispatch(self, req_method: str, url: str, body: Optional[Dict],
+                 headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         parsed = urlparse(url)
         for method, regex, fn in self._routes:
             if method != req_method:
@@ -97,14 +106,88 @@ class Router:
             if not m:
                 continue
             req = Request(req_method, parsed.path, m.groupdict(),
-                          parse_qs(parsed.query), body)
+                          parse_qs(parsed.query), body, headers)
             return fn(req)
         raise HttpError(404, f"no route: {req_method} {parsed.path}")
 
 
-def _make_handler(router: Router):
+class IdempotencyCache:
+    """Replay cache keyed by the client's ``Idempotency-Key`` header.
+
+    Closes the POST-retry gap: a create whose response was lost to a
+    connection drop (or a pod-recovery window) can be retried with the
+    same key and receives the FIRST attempt's recorded outcome — success
+    or error — instead of a spurious 409 from the already-landed create.
+    A concurrent duplicate (client retried while the first attempt is
+    still executing) waits for the original instead of racing it.
+    Bounded FIFO so a long-lived server doesn't leak a record per create.
+    """
+
+    def __init__(self, cap: int = 1024, wait_timeout_s: float = 600.0):
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._cap = cap
+        self._wait_timeout_s = wait_timeout_s
+
+    def run(self, key: Optional[str], fn: Callable[[], Tuple[int, Any]]):
+        if not key:
+            return fn()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                ent = {"done": threading.Event(), "outcome": None}
+                self._entries[key] = ent
+                while len(self._entries) > self._cap:
+                    # Evict the oldest *completed* entry — in-flight
+                    # ones must stay visible to their duplicates, but a
+                    # long-running oldest entry (a minutes-long sync
+                    # build) must not block eviction behind it.
+                    victim = next((k for k, e in self._entries.items()
+                                   if e["done"].is_set()), None)
+                    if victim is None:
+                        break
+                    del self._entries[victim]
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            if not ent["done"].wait(self._wait_timeout_s):
+                raise HttpError(
+                    409, "duplicate request still in flight "
+                    f"(Idempotency-Key {key})")
+            kind, val = ent["outcome"]
+            if kind == "ok":
+                return val
+            raise HttpError(val.status, val.message, headers=val.headers)
+        try:
+            out = fn()
+            ent["outcome"] = ("ok", out)
+            return out
+        except HttpError as e:
+            if e.status == 503:
+                # Transient (pod mid-recovery): drop the entry so the
+                # client's Retry-After retry RE-EXECUTES against the
+                # recovered pod instead of replaying the 503 forever.
+                with self._lock:
+                    self._entries.pop(key, None)
+            ent["outcome"] = ("err", e)
+            raise
+        except Exception as e:  # noqa: BLE001 — replay as a 500
+            ent["outcome"] = ("err", HttpError(500, f"internal error: {e}"))
+            raise
+        finally:
+            ent["done"].set()
+
+
+def _make_handler(router: Router, request_timeout_s: Optional[float] = None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        #: Per-connection socket timeout (socketserver.StreamRequestHandler
+        #: applies it in setup()): a client that sends a Content-Length it
+        #: never delivers — or goes dark mid-request — times out instead
+        #: of pinning a handler thread forever.
+        timeout = request_timeout_s or None
 
         def log_message(self, fmt, *args):  # quiet by default
             pass
@@ -150,7 +233,8 @@ def _make_handler(router: Router):
         def _handle(self, method: str) -> None:
             try:
                 body = self._read_body()
-                status, payload = router.dispatch(method, self.path, body)
+                status, payload = router.dispatch(method, self.path, body,
+                                                  dict(self.headers.items()))
                 if isinstance(payload, FileResponse):
                     self._send_file(payload)
                 elif isinstance(payload, HtmlResponse):
@@ -160,6 +244,12 @@ def _make_handler(router: Router):
             except HttpError as e:
                 self._send_json(e.status, {"result": e.message},
                                 headers=e.headers)
+            except (socket.timeout, TimeoutError):
+                # Connection-level timeout (half-sent body from a hung or
+                # dead client): re-raise so handle_one_request closes the
+                # connection — answering 500 here would treat a dead peer
+                # as a server bug and keep the handler thread engaged.
+                raise
             except Exception as e:  # noqa: BLE001 — request boundary
                 traceback.print_exc()
                 self._send_json(500, {"result": f"internal error: {e}"})
@@ -184,8 +274,10 @@ class Server:
     it in-process; production runs it via ``python -m
     learningorchestra_tpu.serving``)."""
 
-    def __init__(self, router: Router, host: str, port: int):
-        self.httpd = ThreadingHTTPServer((host, port), _make_handler(router))
+    def __init__(self, router: Router, host: str, port: int,
+                 request_timeout_s: Optional[float] = None):
+        self.httpd = ThreadingHTTPServer(
+            (host, port), _make_handler(router, request_timeout_s))
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

@@ -1,0 +1,70 @@
+"""Failpoint-sweep child (tests/test_failpoints.py).
+
+Runs one deterministic catalog workload that traverses EVERY registered
+``catalog.* / ingest.* / store.*`` failpoint site. The parent arms one
+site in ``crash`` mode per run (``LO_TPU_FAILPOINTS=<site>=crash``) and
+asserts the child died with ``failpoints.CRASH_EXIT_CODE`` at that exact
+I/O boundary; it then recovers the store and checks the journaled-prefix
++ checksum invariants. With no failpoint armed the workload completes and
+writes ``done.json`` (the control run, which also records expected row
+counts).
+
+Run as: python tests/failpoint_child.py <root>
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from learningorchestra_tpu.catalog.ingest import ingest_csv_url  # noqa: E402
+from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: E402
+from learningorchestra_tpu.config import Settings  # noqa: E402
+
+root = sys.argv[1]
+
+cfg = Settings()
+cfg.store_root = os.path.join(root, "store")
+cfg.replica_root = os.path.join(root, "replica")
+cfg.persist = True
+cfg.use_native_csv = False          # keep the child dependency-light
+cfg.ingest_chunk_rows = 64          # several chunks from a small CSV
+
+store = DatasetStore(cfg)
+
+# -- 1. streaming ingest from a local file ------------------------------------
+# Hits: ingest.block.post_fetch, catalog.write_chunk.pre_rename,
+# catalog.journal.mid_append, store.mirror.pre_copy, store.finish.pre_save.
+csv_path = os.path.join(root, "src.csv")
+store.create("ing", url=csv_path)
+ingest_csv_url(store, "ing", csv_path, cfg)
+
+# -- 2. append + coercion rewrite ---------------------------------------------
+# Hits: catalog.write_chunk.pre_rename / journal.mid_append again on the
+# appends, then catalog.journal.pre_swap on the set_column generation
+# rewrite.
+ds = store.create("tab", columns={"a": np.arange(100, dtype=np.int64),
+                                  "b": np.arange(100, dtype=np.float64)})
+store.save("tab")
+ds.append_columns({"a": np.arange(100, 200, dtype=np.int64),
+                   "b": np.arange(100, 200, dtype=np.float64)})
+store.save("tab")
+ds.set_column("a", ds.column("a").astype(np.float64))
+store.save("tab")
+store.finish("tab")
+
+# -- 3. cold read-back through checksum verification --------------------------
+# Hits: catalog.chunk.pre_read (fresh store → lazy chunks → verified disk
+# reads).
+store2 = DatasetStore(cfg)
+store2.load("ing")
+store2.load("tab")
+n_ing = len(next(iter(store2.get("ing").columns.values())))
+n_tab = len(next(iter(store2.get("tab").columns.values())))
+assert n_tab == 200, n_tab
+
+with open(os.path.join(root, "done.json"), "w") as f:
+    json.dump({"ing_rows": n_ing, "tab_rows": n_tab}, f)

@@ -1,0 +1,429 @@
+"""Deterministic failpoints + checksummed self-healing data plane.
+
+Three layers of proof:
+
+1. unit: the failpoint registry/arming semantics (parse, nth, one-shot,
+   zero-overhead disarmed) and a fast raise-mode smoke through a real
+   chunk write — the tier-1 guard that keeps the subsystem from rotting;
+2. corruption: torn-write and bitflip injections are *detected* via the
+   journaled per-chunk CRC32 (precise ``ChunkCorrupt``, never a parquet
+   traceback) and *auto-repaired* from the replica mirror, with the
+   counters surfacing on the store;
+3. sweep (slow): for every registered catalog/ingest/store site, a child
+   process is crashed (``os._exit``) at exactly that I/O boundary and
+   the store must recover to a consistent journaled prefix with all
+   checksums green — the Jepsen-style falsifiability the chunk store's
+   crash-consistency claims were missing.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.catalog.dataset import ChunkCorrupt, crc32_file
+from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.utils import failpoints
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "failpoint_child.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def _mk_cfg(tmp_path, replica: bool = True) -> Settings:
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.replica_root = str(tmp_path / "replica") if replica else ""
+    cfg.persist = True
+    return cfg
+
+
+def _mk_csv(root: str, rows: int = 2000) -> str:
+    path = os.path.join(root, "src.csv")
+    with open(path, "w") as f:
+        f.write("a,b\n")
+        for i in range(rows):
+            f.write(f"{i},{i * 0.5}\n")
+    return path
+
+
+# -- 1. registry / arming unit tests -----------------------------------------
+
+def test_registry_has_the_contract_sites():
+    """The sites the docs/tests name must stay registered — the sweep
+    enumerates the registry, so a silently dropped declare() would
+    silently shrink coverage."""
+    got = set(failpoints.sites())
+    for site in ("catalog.write_chunk.pre_rename",
+                 "catalog.journal.mid_append",
+                 "catalog.journal.pre_swap",
+                 "catalog.chunk.pre_read",
+                 "ingest.block.post_fetch",
+                 "store.mirror.pre_copy",
+                 "store.finish.pre_save"):
+        assert site in got
+    # spmd declares lazily safe at import of the parallel package.
+    from learningorchestra_tpu.parallel import spmd  # noqa: F401
+    assert "spmd.dispatch.pre_go" in failpoints.sites()
+
+
+def test_parse_spec_and_errors():
+    armed = failpoints.parse_spec(
+        "a.b=raise, c.d=crash:3 ,e.f=bitflip")
+    assert armed["a.b"].mode == "raise" and armed["a.b"].nth == 1
+    assert armed["c.d"].mode == "crash" and armed["c.d"].nth == 3
+    assert armed["e.f"].mode == "bitflip"
+    with pytest.raises(ValueError, match="unknown failpoint mode"):
+        failpoints.parse_spec("a=explode")
+    with pytest.raises(ValueError, match="site=mode"):
+        failpoints.parse_spec("justasite")
+    with pytest.raises(ValueError, match=">= 1"):
+        failpoints.parse_spec("a=raise:0")
+
+
+def test_disarmed_fire_is_a_noop_and_nth_is_oneshot():
+    site = failpoints.declare("test.unit.site")
+    failpoints.fire(site)                       # disarmed: no-op
+    failpoints.configure(f"{site}=raise:3")
+    failpoints.fire(site)
+    failpoints.fire(site)
+    with pytest.raises(failpoints.FailpointError):
+        failpoints.fire(site)
+    failpoints.fire(site)                       # one-shot: spent
+    assert failpoints.hit_counts()[site] >= 4
+
+
+def test_file_mode_without_path_raises_loudly():
+    site = failpoints.declare("test.unit.file_site")
+    failpoints.configure(f"{site}=torn")
+    with pytest.raises(failpoints.FailpointError):
+        failpoints.fire(site)                   # no path: loud, not no-op
+
+
+def test_smoke_raise_mode_through_a_real_chunk_write(tmp_path):
+    """Tier-1 smoke: an armed raise-mode failpoint at the chunk-write
+    rename boundary surfaces through a real save, and disarming restores
+    normal operation."""
+    cfg = _mk_cfg(tmp_path, replica=False)
+    store = DatasetStore(cfg)
+    ds = store.create("smoke")
+    ds.append_columns({"x": np.arange(10)})      # not yet flushed
+    failpoints.configure("catalog.write_chunk.pre_rename=raise")
+    with pytest.raises(failpoints.FailpointError):
+        store.save("smoke")
+    failpoints.reset()
+    store.save("smoke")                          # disarmed: write lands
+    store2 = DatasetStore(cfg)
+    assert store2.load("smoke").num_rows == 10
+    assert store2.scrub("smoke")["ok"]
+
+
+# -- 2. checksum detection / self-healing -------------------------------------
+
+def _seed_mirrored(cfg, rows: int = 50):
+    store = DatasetStore(cfg)
+    store.create("d", columns={"x": np.arange(rows, dtype=np.int64)})
+    store.save("d")
+    store.finish("d")
+    return store
+
+
+def test_torn_write_detected_as_chunk_corrupt(tmp_path):
+    """A torn chunk write (truncated after checksum, before rename) is
+    caught by CRC32 verification on first read — a precise ChunkCorrupt,
+    not an arrow/parquet traceback — when no replica exists to heal it."""
+    cfg = _mk_cfg(tmp_path, replica=False)
+    store = DatasetStore(cfg)
+    ds = store.create("d")
+    ds.append_columns({"x": np.arange(50, dtype=np.int64)})
+    failpoints.configure("catalog.write_chunk.pre_rename=torn")
+    store.save("d")                              # journals a good crc
+    failpoints.reset()                           # over a torn file
+    store2 = DatasetStore(cfg)
+    ds = store2.load("d")
+    with pytest.raises(ChunkCorrupt, match="checksum mismatch"):
+        _ = ds.columns
+    assert store2.integrity_snapshot()["chunks_corrupt"] == 1
+    assert store2.integrity_snapshot()["chunks_repaired"] == 0
+    report = store2.scrub("d")
+    assert not report["ok"] and report["errors"]["d"]
+
+
+def test_torn_write_never_propagates_into_the_mirror(tmp_path):
+    """Mirroring verifies each chunk's CRC before copying: a corrupt
+    primary file fails the save with ChunkCorrupt instead of silently
+    replicating rot into the availability tier."""
+    cfg = _mk_cfg(tmp_path, replica=True)
+    store = DatasetStore(cfg)
+    ds = store.create("d")
+    ds.append_columns({"x": np.arange(50, dtype=np.int64)})
+    failpoints.configure("catalog.write_chunk.pre_rename=torn")
+    with pytest.raises(ChunkCorrupt):
+        store.save("d")
+    failpoints.reset()
+    rchunks = os.path.join(cfg.replica_root, "d", "chunks")
+    assert not os.path.isdir(rchunks) or not os.listdir(rchunks)
+
+
+def test_bitflip_auto_repaired_from_replica(tmp_path):
+    """Bit rot injected (failpoint ``bitflip``) right before the first
+    cold read of a mirrored chunk: detection via CRC mismatch, automatic
+    repair from the replica, correct values, counters visible."""
+    cfg = _mk_cfg(tmp_path, replica=True)
+    _seed_mirrored(cfg)
+    failpoints.configure("catalog.chunk.pre_read=bitflip")
+    store2 = DatasetStore(cfg)
+    ds = store2.load("d")
+    np.testing.assert_array_equal(ds.column("x"),
+                                  np.arange(50, dtype=np.int64))
+    snap = store2.integrity_snapshot()
+    assert snap["chunks_corrupt"] == 1 and snap["chunks_repaired"] == 1
+    failpoints.reset()
+    assert store2.scrub("d")["ok"]
+
+
+def test_missing_chunk_file_repaired_from_replica(tmp_path):
+    """A journaled chunk file deleted from the primary (disk loss at file
+    granularity) is restored from the replica on read."""
+    cfg = _mk_cfg(tmp_path, replica=True)
+    _seed_mirrored(cfg)
+    chunks = os.path.join(cfg.store_root, "d", "chunks")
+    for fn in os.listdir(chunks):
+        os.remove(os.path.join(chunks, fn))
+    store2 = DatasetStore(cfg)
+    ds = store2.load("d")
+    np.testing.assert_array_equal(ds.column("x"),
+                                  np.arange(50, dtype=np.int64))
+    snap = store2.integrity_snapshot()
+    assert snap["chunks_corrupt"] == 1 and snap["chunks_repaired"] == 1
+
+
+def test_scrub_detects_rot_after_first_read(tmp_path):
+    """Scrub re-reads every file even if already lazily verified — rot
+    that sets in after the first read is still caught (and healed)."""
+    cfg = _mk_cfg(tmp_path, replica=True)
+    store = _seed_mirrored(cfg)
+    _ = store.get("d")                           # warm, already verified
+    store2 = DatasetStore(cfg)
+    ds = store2.load("d")
+    _ = ds.columns                               # first read: verified
+    chunks = os.path.join(cfg.store_root, "d", "chunks")
+    fn = sorted(os.listdir(chunks))[0]
+    path = os.path.join(chunks, fn)
+    with open(path, "r+b") as f:                 # flip a byte mid-file
+        f.seek(os.path.getsize(path) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    report = store2.scrub("d")
+    assert report["ok"] and report["checked"] >= 1
+    assert store2.integrity_snapshot()["chunks_repaired"] == 1
+    # repaired file verifies against the journaled crc
+    rec = json.loads(open(os.path.join(
+        cfg.store_root, "d", "journal.jsonl")).readline())
+    assert crc32_file(path) == rec["crc32"]
+
+
+def test_scrub_on_load_marks_unrepairable_datasets(tmp_path):
+    """Recovery-scan verification (Settings.scrub_on_load): corruption
+    with no replica to heal from surfaces on the dataset's metadata as a
+    precise error instead of lurking until a read."""
+    cfg = _mk_cfg(tmp_path, replica=False)
+    _seed_mirrored(cfg)
+    chunks = os.path.join(cfg.store_root, "d", "chunks")
+    fn = sorted(os.listdir(chunks))[0]
+    with open(os.path.join(chunks, fn), "r+b") as f:
+        f.truncate(max(os.path.getsize(os.path.join(chunks, fn)) // 2, 1))
+    cfg2 = cfg.replace(scrub_on_load=True)
+    store2 = DatasetStore(cfg2)
+    store2.load_all()
+    meta = store2.get("d").metadata
+    assert meta.error and "chunk corruption" in meta.error
+    assert store2.integrity_snapshot()["chunks_corrupt"] >= 1
+
+
+def test_scrub_on_load_with_replica_survives_unrepairable_rot(tmp_path):
+    """Recovery hardening: an unrepairable corrupt dataset (replica copy
+    gone too) must not abort the whole load_all — it gets marked, is
+    dropped from the resumable-ingest list (resuming would append to a
+    damaged dataset), and the rest of the catalog loads."""
+    cfg = _mk_cfg(tmp_path, replica=True)
+    store = DatasetStore(cfg)
+    ds = store.create("ing", url=str(tmp_path / "src.csv"))
+    ds.append_columns({"x": np.arange(40, dtype=np.int64)}, src_off=400)
+    store.save("ing")                            # journaled + mirrored
+    store.create("ok", columns={"y": np.arange(5)})
+    store.save("ok")
+    store.finish("ok")
+    # corrupt the primary AND its replica copy: unrepairable
+    for root in (cfg.store_root, cfg.replica_root):
+        chunks = os.path.join(root, "ing", "chunks")
+        for fn in os.listdir(chunks):
+            with open(os.path.join(chunks, fn), "r+b") as f:
+                f.truncate(3)
+    cfg2 = cfg.replace(scrub_on_load=True)
+    store2 = DatasetStore(cfg2)
+    loaded = store2.load_all(resume_ingests=True)    # must not raise
+    assert set(loaded) == {"ing", "ok"}
+    assert "ing" not in store2.resumable_ingests
+    meta = store2.get("ing").metadata
+    assert meta.finished and "chunk corruption" in (meta.error or "")
+    assert store2.get("ok").metadata.finished
+    assert store2.scrub("ok")["ok"]
+
+
+def test_legacy_journal_without_checksums_still_loads(tmp_path):
+    """Pre-checksum journal records (no ``crc32`` key) load, read, and
+    scrub as 'unchecksummed' — no false corruption on old stores."""
+    cfg = _mk_cfg(tmp_path, replica=False)
+    store = DatasetStore(cfg)
+    store.create("d", columns={"x": np.arange(20, dtype=np.int64)})
+    store.save("d")
+    jpath = os.path.join(cfg.store_root, "d", "journal.jsonl")
+    recs = [json.loads(ln) for ln in open(jpath)]
+    with open(jpath, "w") as f:
+        for rec in recs:
+            rec.pop("crc32", None)
+            f.write(json.dumps(rec) + "\n")
+    store2 = DatasetStore(cfg)
+    ds = store2.load("d")
+    np.testing.assert_array_equal(ds.column("x"),
+                                  np.arange(20, dtype=np.int64))
+    report = store2.scrub("d")
+    assert report["ok"] and report["unchecksummed"] >= 1
+
+
+# -- satellite: journal-truncation recovery fuzz ------------------------------
+
+def test_journal_truncation_recovers_to_prefix_at_every_byte(tmp_path):
+    """Fuzz-truncate journal.jsonl at every byte boundary within the
+    final record: recovery must land on the journaled prefix (the first
+    two commits) with all checksums green — the file-corruption
+    complement of the crash-site sweep."""
+    cfg = _mk_cfg(tmp_path, replica=False)
+    store = DatasetStore(cfg)
+    ds = store.create("d", columns={"x": np.arange(30, dtype=np.int64)})
+    store.save("d")
+    ds.append_columns({"x": np.arange(30, 60, dtype=np.int64)})
+    store.save("d")
+    ds.append_columns({"x": np.arange(60, 90, dtype=np.int64)})
+    store.save("d")
+    ds_dir = os.path.join(cfg.store_root, "d")
+    jpath = os.path.join(ds_dir, "journal.jsonl")
+    full = open(jpath, "rb").read()
+    lines = full.splitlines(keepends=True)
+    assert len(lines) == 3
+    # Recovery GCs chunk files the truncated journal orphans (correct —
+    # they're crash debris), so each cut runs against a pristine copy.
+    pristine = str(tmp_path / "pristine")
+    shutil.copytree(ds_dir, pristine)
+    last_start = len(full) - len(lines[-1])
+    # A cut that strips only the record's trailing newline leaves a
+    # complete JSON line — that record IS durable and must recover.
+    json_end = last_start + len(lines[-1].rstrip(b"\r\n"))
+    for cut in range(last_start, len(full)):
+        shutil.rmtree(ds_dir)
+        shutil.copytree(pristine, ds_dir)
+        with open(jpath, "wb") as f:
+            f.write(full[:cut])
+        st = DatasetStore(cfg)
+        d2 = st.load("d")
+        want = 90 if cut >= json_end else 60
+        assert d2.num_rows == want, f"cut at byte {cut}: {d2.num_rows}"
+        assert st.scrub("d")["ok"], f"cut at byte {cut}"
+        np.testing.assert_array_equal(
+            d2.column("x"), np.arange(want, dtype=np.int64))
+    shutil.rmtree(ds_dir)
+    shutil.copytree(pristine, ds_dir)            # restore: full journal
+    st = DatasetStore(cfg)
+    assert st.load("d").num_rows == 90
+
+
+# -- 3. the crash-site sweep (slow) -------------------------------------------
+
+def _run_child(root: str, env_extra: dict) -> subprocess.CompletedProcess:
+    env = dict(os.environ, **env_extra)
+    env.pop("LO_TPU_REPLICA_ROOT", None)
+    return subprocess.run([sys.executable, CHILD, root],
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+
+
+def _sweep_sites():
+    # Import for the side effect of declaring every data-plane site.
+    import learningorchestra_tpu.catalog.ingest  # noqa: F401
+    return [s for s in failpoints.sites()
+            if s.startswith(("catalog.", "ingest.", "store."))
+            and not s.startswith("test.")]
+
+
+def test_control_child_completes(tmp_path):
+    """No failpoint armed: the sweep workload itself is sound and
+    traverses to completion (guards the sweep against vacuous passes)."""
+    root = str(tmp_path)
+    _mk_csv(root)
+    proc = _run_child(root, {})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    done = json.load(open(os.path.join(root, "done.json")))
+    assert done["tab_rows"] == 200 and done["ing_rows"] == 2000
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", _sweep_sites())
+def test_crash_sweep_recovers_to_journaled_prefix(tmp_path, site):
+    """THE acceptance sweep: crash a child at every registered
+    catalog/ingest/store failpoint site; recovery must yield a loadable
+    store whose datasets are journaled prefixes with green checksums and
+    terminal (or resumable-ingest) metadata, and the store must remain
+    writable."""
+    root = str(tmp_path)
+    _mk_csv(root)
+    proc = _run_child(root, {failpoints.ENV_VAR: f"{site}=crash"})
+    assert proc.returncode == failpoints.CRASH_EXIT_CODE, (
+        f"site {site}: expected crash exit {failpoints.CRASH_EXIT_CODE}, "
+        f"got {proc.returncode}\n{proc.stderr[-2000:]}")
+    assert not os.path.exists(os.path.join(root, "done.json"))
+
+    cfg = Settings()
+    cfg.store_root = os.path.join(root, "store")
+    cfg.replica_root = os.path.join(root, "replica")
+    cfg.persist = True
+    cfg.scrub_on_load = True         # recovery scan verifies checksums
+    store = DatasetStore(cfg)
+    loaded = store.load_all()
+    for name in loaded:
+        ds = store.get(name)
+        # consistent journaled prefix: every journaled chunk verifies...
+        assert store.scrub(name)["ok"], f"site {site}: {name} not green"
+        # ...and is readable end-to-end
+        cols = ds.columns
+        n = len(next(iter(cols.values()))) if cols else 0
+        assert n == ds.num_rows
+        # every dataset reached a terminal state (finished, failed, or
+        # a resumable ingest listed for restart)
+        assert (ds.metadata.finished
+                or name in store.resumable_ingests
+                or ds.metadata.error), f"site {site}: {name} non-terminal"
+        assert not (ds.metadata.error or "").startswith(
+            "chunk corruption"), f"site {site}: {name} failed checksums"
+    # prefix bound: never MORE rows than the completed control workload
+    if "ing" in loaded:
+        assert store.get("ing").num_rows <= 2000
+    if "tab" in loaded:
+        assert store.get("tab").num_rows <= 200
+    # the recovered store stays fully usable
+    store.create("post", columns={"y": np.arange(5)})
+    store.save("post")
+    assert store.scrub("post")["ok"]
+    shutil.rmtree(root, ignore_errors=True)

@@ -308,8 +308,10 @@ def test_client_times_out_on_hung_server():
 
 
 def test_client_retries_connection_errors():
-    """GETs retry with backoff on connection errors; POSTs never do (a
-    landed create would resurface as a spurious 409)."""
+    """Connection errors retry with backoff on every method — POSTs
+    included, now that each carries an Idempotency-Key the server
+    dedupes on (the key is stable across one request's retries, so a
+    landed first attempt replays instead of 409ing)."""
     import requests
 
     # nothing listens on this port: immediate connection refusal
@@ -318,7 +320,8 @@ def test_client_retries_connection_errors():
     orig = requests.request
 
     def counting(method, url, **kw):
-        calls.append(method)
+        calls.append((method, (kw.get("headers") or {}).get(
+            "Idempotency-Key")))
         return orig(method, url, **kw)
 
     requests.request = counting
@@ -329,6 +332,137 @@ def test_client_retries_connection_errors():
         calls.clear()
         with pytest.raises(requests.ConnectionError):
             dead.post("/files", json={})
-        assert len(calls) == 1          # POST: no auto-retry
+        assert len(calls) == 3          # POSTs retry too now
+        keys = {k for _, k in calls}
+        assert len(keys) == 1 and None not in keys  # one stable key
     finally:
         requests.request = orig
+
+
+def test_client_backoff_capped_jittered_and_total_bounded(monkeypatch):
+    """Backoff hardening: per-sleep capped at backoff_cap_seconds, total
+    sleep across one logical request capped at max_retry_wait (past it
+    the error surfaces even with retries left)."""
+    import requests
+
+    from learningorchestra_tpu import client as client_mod
+
+    sleeps = []
+    monkeypatch.setattr(client_mod.time, "sleep",
+                        lambda s: sleeps.append(s))
+    dead = Context("http://127.0.0.1:1", retries=50, backoff_seconds=4.0,
+                   backoff_cap_seconds=2.0, max_retry_wait=5.0)
+    with pytest.raises(requests.ConnectionError):
+        dead.get("/files")
+    assert sleeps, "expected retries"
+    assert all(s <= 2.0 for s in sleeps)         # per-sleep cap (jittered)
+    assert sum(sleeps) <= 5.0 + 1e-9             # total-wait cap
+    assert len(sleeps) < 50                      # budget beat the retries
+
+
+def test_client_clamps_retry_after(monkeypatch):
+    """A server's Retry-After hint is honored but clamped — a confused
+    server must not park the client for hours."""
+    from learningorchestra_tpu import client as client_mod
+
+    class Fake503:
+        status_code = 503
+        headers = {"Retry-After": "10000"}
+
+    monkeypatch.setattr(client_mod.requests, "request",
+                        lambda *a, **kw: Fake503())
+    sleeps = []
+    monkeypatch.setattr(client_mod.time, "sleep",
+                        lambda s: sleeps.append(s))
+    ctx = Context("http://x", retries=1, retry_after_cap=7.0,
+                  max_retry_wait=100.0)
+    resp = ctx.get("/files")
+    assert resp.status_code == 503
+    assert sleeps == [7.0]                        # clamped, not 10000
+
+
+def test_server_times_out_half_sent_request(tmp_path):
+    """A client that promises a body it never sends must not pin a
+    handler thread forever: the per-connection socket timeout
+    (Settings.http_timeout_s) closes the connection, and the server
+    keeps serving others."""
+    import socket
+    import time as _time
+
+    import requests
+
+    from learningorchestra_tpu.config import Settings
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.image_root = str(tmp_path / "images")
+    cfg.port = 0
+    cfg.persist = False
+    cfg.http_timeout_s = 0.5
+    app = App(cfg, recover=False)
+    server = app.serve(background=True)
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        s.sendall(b"POST /files HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: 100\r\n\r\n{\"par")   # half-sent body
+        s.settimeout(10)
+        t0 = _time.time()
+        data = s.recv(4096)
+        assert data == b"", f"expected close, got {data[:100]!r}"
+        assert _time.time() - t0 < 8.0
+        s.close()
+        # handler thread freed; server still answers
+        r = requests.get(f"http://127.0.0.1:{server.port}/files", timeout=10)
+        assert r.status_code == 200
+    finally:
+        server.stop()
+
+
+def test_idempotent_duplicate_create(served):
+    """Duplicate creates sharing an Idempotency-Key replay the first
+    attempt's response (one dataset, one ingest job) — the pod-recovery
+    window can no longer strand a retried create on a spurious 409."""
+    import uuid
+
+    import requests
+
+    ctx, app, csv_path = served
+    key = uuid.uuid4().hex
+    body = {"filename": "idem1", "url": csv_path}
+    r1 = requests.post(ctx.url("/files"), json=body,
+                       headers={"Idempotency-Key": key})
+    r2 = requests.post(ctx.url("/files"), json=body,
+                       headers={"Idempotency-Key": key})
+    assert r1.status_code == 201 and r2.status_code == 201
+    assert r1.json() == r2.json()
+    jobs = [j for j in requests.get(ctx.url("/jobs")).json()
+            if j["dataset"] == "idem1" and j["kind"] == "ingest"]
+    assert len(jobs) == 1                        # deduped, not re-run
+    # a DIFFERENT key is a genuine duplicate: 409, replayed consistently
+    r3 = requests.post(ctx.url("/files"), json=body,
+                       headers={"Idempotency-Key": uuid.uuid4().hex})
+    assert r3.status_code == 409
+    # and the SDK path (auto-keyed) still works end-to-end
+    DatabaseApi(ctx).create_file("idem2", csv_path, wait=True)
+
+
+def test_scrub_route_and_integrity_metrics(served):
+    """POST /catalog/scrub verifies the catalog's chunk checksums and
+    GET /metrics exposes the corruption/repair counters."""
+    import requests
+
+    ctx, app, csv_path = served
+    DatabaseApi(ctx).create_file("scrub_probe", csv_path, wait=True)
+    r = requests.post(ctx.url("/catalog/scrub"), json={})
+    assert r.status_code == 200
+    report = r.json()
+    assert report["ok"] and report["checked"] >= 1
+    # single-dataset form + unknown dataset → 404
+    r = requests.post(ctx.url("/catalog/scrub"),
+                      json={"dataset": "scrub_probe"})
+    assert r.status_code == 200 and r.json()["ok"]
+    r = requests.post(ctx.url("/catalog/scrub"), json={"dataset": "nope"})
+    assert r.status_code == 404
+    m = requests.get(ctx.url("/metrics")).json()
+    assert m["integrity"]["scrub_runs"] >= 2
+    assert m["integrity"]["chunks_corrupt"] == 0
