@@ -39,11 +39,13 @@ import threading
 import time
 import weakref
 import zlib
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from learningorchestra_tpu.catalog import readpipe
 from learningorchestra_tpu.utils import failpoints
 
 #: Columns are numpy arrays: numeric dtypes or ``object`` for strings/mixed.
@@ -262,7 +264,11 @@ class _Chunk:
 
     def materialize(self, fields: Optional[List[str]] = None) -> Columns:
         """Column data for this chunk (optionally a field subset). Disk
-        reads are NOT cached back — streaming consumers stay bounded.
+        reads are never cached back onto the chunk object (streaming
+        consumers stay bounded per dataset); they DO go through the
+        byte-budgeted process-wide LRU chunk cache (catalog/readpipe.py),
+        whose CRC-pinned keys and budget keep that sharing safe and
+        bounded.
 
         Disk reads coerce to the chunk's *current* ``dtypes``: consolidation
         may have re-pointed an already-flushed chunk at dtype-promoted (or
@@ -285,13 +291,28 @@ class _Chunk:
                         if fields is None or name in fields}
                 return ({f: data[f] for f in fields} if fields is not None
                         else data)
-            if not self._verified and self.verify is not None:
-                # First disk read: checksum the file (repairing from the
-                # replica on mismatch) before handing bytes to the arrow
-                # reader — corruption surfaces as ChunkCorrupt here, not
-                # as a parse traceback deep inside a fit.
-                self.verify(self)
-            data = read_chunk_file(self.path, fields)
+            # Warm-path: the byte-budgeted LRU chunk cache (readpipe)
+            # keyed by (path, journal CRC32, field selection) — the raw
+            # decoded read, shared across passes/datasets. A hit skips
+            # the file read AND its first-read verification (the cached
+            # bytes were verified when they were read); the dtype
+            # coercion below still runs per call against the chunk's
+            # CURRENT dtypes, so cached data can never drift from what a
+            # fresh read would yield.
+            fkey = None if fields is None else tuple(fields)
+            data = readpipe.cache_get(self.path, self.crc32, fkey)
+            if data is None:
+                if not self._verified and self.verify is not None:
+                    # First disk read: checksum the file (repairing from
+                    # the replica on mismatch) before handing bytes to
+                    # the arrow reader — corruption surfaces as
+                    # ChunkCorrupt here, not as a parse traceback deep
+                    # inside a fit.
+                    self.verify(self)
+                data = read_chunk_file(self.path, fields)
+                readpipe.cache_put(
+                    self.path, self.crc32, fkey, data,
+                    sum(_arr_bytes(a) for a in data.values()))
             for f, a in data.items():
                 want = self.dtypes.get(f)
                 if want is not None and a.dtype != want:
@@ -322,6 +343,9 @@ class Dataset:
         self._chunk_dir: Optional[str] = None
         self._journal_path: Optional[str] = None
         self._ram_budget: Optional[int] = None
+        #: Prefetch window for streaming reads (iter_chunks / snapshot
+        #: scans); None = the process default (LO_TPU_PREFETCH_CHUNKS).
+        self._prefetch: Optional[int] = None
         #: Chunk files are named ``GGG-NNNNN.parquet``: the generation bumps
         #: on every rewrite (set_column) so filenames never collide across
         #: rewrites — old-generation files stay valid until the new journal
@@ -354,13 +378,18 @@ class Dataset:
     # -- storage wiring (set by DatasetStore) --------------------------------
 
     def attach_storage(self, chunk_dir: str, journal_path: str,
-                       ram_budget_bytes: Optional[int] = None) -> None:
+                       ram_budget_bytes: Optional[int] = None,
+                       prefetch_chunks: Optional[int] = None) -> None:
         """Wire the on-disk chunk tier: where flushed/evicted chunks go and
-        how much column data may stay resident in host RAM."""
+        how much column data may stay resident in host RAM.
+        ``prefetch_chunks`` pins this dataset's streaming-read prefetch
+        window (None = the process default)."""
         with self._data_lock:
             self._chunk_dir = chunk_dir
             self._journal_path = journal_path
             self._ram_budget = ram_budget_bytes or None
+            if prefetch_chunks is not None:
+                self._prefetch = prefetch_chunks
             self._maybe_evict_locked()
 
     def set_repair_hook(self, hook: Optional[Callable]) -> None:
@@ -650,12 +679,18 @@ class Dataset:
         self._pending_gc = False
         referenced = {os.path.basename(c.path) for c in self._chunks
                       if c.path is not None}
+        removed = []
         for fn in os.listdir(self._chunk_dir):
             if fn not in referenced:
                 try:
                     os.remove(os.path.join(self._chunk_dir, fn))
+                    removed.append(os.path.join(self._chunk_dir, fn))
                 except FileNotFoundError:
                     pass
+        if removed:
+            # Prompt byte-reclaim only — cache keys are CRC-pinned, so a
+            # stale entry could never be served wrongly, just held.
+            readpipe.invalidate_files(removed)
 
     @property
     def rewrite_needed(self) -> bool:
@@ -896,10 +931,16 @@ class Dataset:
         return self.columns[name]
 
     def iter_chunks(self, fields: Optional[List[str]] = None,
-                    max_chunks: Optional[int] = None) -> Iterator[Columns]:
+                    max_chunks: Optional[int] = None,
+                    prefetch: Optional[int] = None) -> Iterator[Columns]:
         """Stream the dataset chunk-by-chunk without full materialization —
-        the out-of-core compute path (histogram, projection). Spilled chunks
-        are read from their parquet files one at a time and not cached.
+        the out-of-core compute path (histogram, projection). Spilled
+        chunks are read from their chunk files through the prefetching
+        read pipeline: while the consumer computes on chunk i, a worker
+        pool reads + verifies + decodes chunks i+1..i+K (``prefetch``;
+        None = the dataset/process default, 0 = strictly synchronous —
+        the parity oracle). Reads go through the shared LRU chunk cache,
+        so a second pass over the same snapshot hits warm host RAM.
 
         Yielded chunks carry *unified* dtypes matching what full
         consolidation would produce: a field that is object (string) in any
@@ -907,13 +948,19 @@ class Dataset:
         mixed numeric dtypes promote to their ``np.result_type`` (so e.g. a
         column integral in early chunks and float later yields float keys
         everywhere, agreeing with ``value_counts`` on the same data).
+        Prefetch never changes yield order or values: futures are consumed
+        in submission order and coercion runs on the consumer thread, so
+        the pipeline is bit-identical to the synchronous oracle. A worker
+        failure (``ChunkCorrupt``, an armed failpoint) re-raises here, on
+        the consumer, at the failed chunk's position.
 
         The snapshot registers as an active reader for its lifetime: chunk
         file GC (generation rewrites) defers until the iterator is
-        exhausted or closed, so lazily-read files stay valid. This is a
-        generator function — the snapshot and reader registration happen at
-        the first ``next()``, so an iterator that is never started never
-        leaks a reader count.
+        exhausted or closed, so lazily-read files stay valid — in-flight
+        prefetch reads are drained before the registration drops. This is
+        a generator function — the snapshot and reader registration happen
+        at the first ``next()``, so an iterator that is never started
+        never leaks a reader count.
 
         ``max_chunks`` truncates the snapshot *before* dtype unification:
         the SPMD histogram pins a journaled chunk count so every pod
@@ -925,12 +972,19 @@ class Dataset:
             if max_chunks is not None:
                 chunks = chunks[:max_chunks]
             self._active_readers += 1
+        pipeline = _pipelined_materialize(
+            chunks, fields,
+            readpipe.prefetch_depth(
+                prefetch if prefetch is not None else self._prefetch))
         try:
             coerce = self._make_coercer(chunks, fields)
-            for c in chunks:
-                cols = c.materialize(fields)
+            for _c, cols in pipeline:
                 yield {f: coerce(f, a) for f, a in cols.items()}
         finally:
+            # Drain the worker window BEFORE releasing the reader: a
+            # deferred generation-rewrite GC must never delete a file a
+            # still-running prefetch worker is reading.
+            pipeline.close()
             self._release_reader()
 
     @staticmethod
@@ -1273,6 +1327,60 @@ def stringify_numeric(a: np.ndarray) -> np.ndarray:
     return out
 
 
+def _pipelined_materialize(chunks: List["_Chunk"],
+                           fields: Optional[List[str]],
+                           depth: int):
+    """Yield ``(chunk, columns)`` in chunk order, materializing up to
+    ``depth`` chunks ahead on the shared readpipe worker pool — the
+    asynchronous read pipeline under ``iter_chunks`` / ``scan``.
+
+    ``depth <= 0`` (or a trivial snapshot) degenerates to the exact
+    synchronous loop — the parity oracle. Otherwise a bounded sliding
+    window of futures keeps at most ``depth`` reads in flight; results
+    are consumed strictly in submission order, so chunk order (and
+    therefore SPMD device-op alignment) is deterministic, and a worker
+    exception re-raises on the consumer thread at the failed chunk's
+    position instead of hanging the stream. On close/abandonment the
+    window is cancelled and in-flight reads are waited out, so callers
+    can safely drop reader registrations (chunk-file GC) afterwards."""
+    if depth <= 0 or len(chunks) <= 1:
+        for c in chunks:
+            yield c, c.materialize(fields)
+        return
+    pool = readpipe.pool()
+    window: deque = deque()          # (chunk, future), submission order
+    nxt = 0
+    try:
+        while nxt < len(chunks) and len(window) < depth:
+            c = chunks[nxt]
+            nxt += 1
+            window.append((c, pool.submit(c.materialize, fields)))
+        while window:
+            c, fut = window.popleft()
+            if not fut.done():
+                readpipe.bump("prefetch_stalls")
+            try:
+                cols = fut.result()
+            except BaseException:
+                readpipe.bump("worker_errors")
+                raise
+            readpipe.bump("prefetched_chunks")
+            if nxt < len(chunks):
+                c2 = chunks[nxt]
+                nxt += 1
+                window.append((c2, pool.submit(c2.materialize, fields)))
+            yield c, cols
+    finally:
+        for _c, fut in window:
+            fut.cancel()
+        for _c, fut in window:
+            if not fut.cancelled():
+                try:
+                    fut.result()
+                except BaseException:  # noqa: BLE001 — result discarded
+                    pass
+
+
 class SnapshotReader:
     """Row reads over one pinned chunk snapshot (``Dataset.snapshot``).
 
@@ -1338,23 +1446,35 @@ class SnapshotReader:
         return {f: _concat([p[f] for p in parts]) for f in parts[0]}
 
     def scan(self, fields: Optional[List[str]] = None,
-             block_rows: int = 1 << 16):
+             block_rows: int = 1 << 16, prefetch: Optional[int] = None):
         """Yield ``(offset, n_block, cols)`` row blocks over the snapshot
         — each chunk materialized once, split into ≤``block_rows`` pieces.
         ``fields`` projects columns (a filtered read scans only the
         query's fields); ``cols`` may be empty when ``fields`` is, which
-        is why the block length is yielded explicitly."""
+        is why the block length is yielded explicitly. Chunks stream
+        through the prefetching read pipeline (next chunks read/decoded
+        by workers while the consumer computes on this one; ``prefetch``
+        None = the dataset/process default, 0 = synchronous oracle) and
+        the shared chunk cache, so a second scan of the same snapshot —
+        the fused streamed-fit's second pass — hits warm host RAM."""
         coerce = self._coercer(fields)
         off = 0
-        for c in self._chunks:
-            cols = None
-            for s in range(0, c.n_rows, block_rows):
-                e = min(s + block_rows, c.n_rows)
-                if cols is None:
-                    cols = c.materialize(fields)
-                yield (off + s, e - s,
-                       {f: coerce(f, a[s:e]) for f, a in cols.items()})
-            off += c.n_rows
+        pipeline = _pipelined_materialize(
+            self._chunks, fields,
+            readpipe.prefetch_depth(
+                prefetch if prefetch is not None else self._ds._prefetch))
+        try:
+            for c, cols in pipeline:
+                for s in range(0, c.n_rows, block_rows):
+                    e = min(s + block_rows, c.n_rows)
+                    yield (off + s, e - s,
+                           {f: coerce(f, a[s:e]) for f, a in cols.items()})
+                off += c.n_rows
+        finally:
+            # Abandoned scans (a filtered read that early-outs) must
+            # drain in-flight prefetch reads before the enclosing
+            # snapshot's reader registration can release.
+            pipeline.close()
 
 
 def rows_from(cols: Columns, fields: List[str], indices: np.ndarray,

@@ -61,6 +61,30 @@ class InvalidCsvUrl(ValueError):
 _CHUNK_BYTES = 1 << 20          # 1 MiB download chunks
 _QUEUE_DEPTH = 64               # bounded: ~64 MiB in flight max
 
+_session_lock = threading.Lock()
+_session = None
+
+
+def _http_session():
+    """Process-wide pooled ``requests.Session``. One logical ingest can
+    hit the source several times — the HEAD identity probe, the body GET,
+    and every ranged re-fetch a resume issues — and per-call
+    ``requests.get`` pays TCP+TLS setup each time. The pooled session
+    reuses connections across all of them (and across concurrent
+    ingests; Session is thread-safe for request dispatch)."""
+    global _session
+    with _session_lock:
+        if _session is None:
+            import requests
+            from requests.adapters import HTTPAdapter
+
+            s = requests.Session()
+            adapter = HTTPAdapter(pool_connections=4, pool_maxsize=8)
+            s.mount("http://", adapter)
+            s.mount("https://", adapter)
+            _session = s
+        return _session
+
 #: Hard ceiling on one row-aligned block. The native tokenizer stores cell
 #: spans as uint32 with the high bit reserved (csv_parser.cpp kArenaBit)
 #: and int32 Arrow offsets, so blocks must stay well under 2 GiB. Without
@@ -115,10 +139,9 @@ def _source_identity(url: str, timeout: float) -> dict:
     when nothing is observable."""
     try:
         if url.startswith(("http://", "https://")):
-            import requests
-
-            resp = requests.head(url, timeout=timeout, allow_redirects=True,
-                                 headers={"Accept-Encoding": "identity"})
+            resp = _http_session().head(
+                url, timeout=timeout, allow_redirects=True,
+                headers={"Accept-Encoding": "identity"})
             if resp.status_code >= 400:
                 return {}
             out = {}
@@ -148,8 +171,6 @@ def _open_url_stream(url: str, timeout: float,
     at a byte offset (ingest resume). HTTP uses a Range request, falling
     back to skip-reading when the server ignores it."""
     if url.startswith(("http://", "https://")):
-        import requests
-
         # identity: byte offsets journal positions in the DECODED stream
         # (iter_content gunzips transparently), but a Range request
         # addresses the on-the-wire representation — with gzip the two
@@ -157,8 +178,8 @@ def _open_url_stream(url: str, timeout: float,
         headers = {"Accept-Encoding": "identity"}
         if offset:
             headers["Range"] = f"bytes={offset}-"
-        resp = requests.get(url, stream=True, timeout=timeout,
-                            headers=headers)
+        resp = _http_session().get(url, stream=True, timeout=timeout,
+                                   headers=headers)
         if offset and resp.status_code == 416:
             # Unsatisfiable range. RFC 7233 makes offset == total length
             # unsatisfiable too, so a fully-committed ingest whose finish
@@ -170,8 +191,9 @@ def _open_url_stream(url: str, timeout: float,
                 return iter(())             # every byte already committed
             if total is None:
                 # Can't tell from the 416: re-fetch in full and skip.
-                resp = requests.get(url, stream=True, timeout=timeout,
-                                    headers={"Accept-Encoding": "identity"})
+                resp = _http_session().get(
+                    url, stream=True, timeout=timeout,
+                    headers={"Accept-Encoding": "identity"})
                 resp.raise_for_status()
                 return _skip_bytes(
                     resp.iter_content(chunk_size=_CHUNK_BYTES), offset)
@@ -361,9 +383,11 @@ def _run_ingest(store: DatasetStore, name: str, url: str, cfg,
                                                        os.cpu_count() or 1))
     pool = ThreadPoolExecutor(max_workers=n_threads,
                               thread_name_prefix="lo-ingest-parse")
+    commit_pool = ThreadPoolExecutor(max_workers=1,
+                                     thread_name_prefix="lo-ingest-commit")
     try:
-        _pipeline(store, ds, name, chunks_q, pool, n_threads, fields,
-                  start_offset or 0, cfg)
+        _pipeline(store, ds, name, chunks_q, pool, commit_pool, n_threads,
+                  fields, start_offset or 0, cfg)
     finally:
         # Unblock and reap the downloader even when the parser raised
         # mid-stream; otherwise it parks forever on the bounded queue
@@ -376,11 +400,13 @@ def _run_ingest(store: DatasetStore, name: str, url: str, cfg,
                 break
         t.join(timeout=5.0)
         pool.shutdown(wait=True, cancel_futures=True)
+        commit_pool.shutdown(wait=True)
     store.finish(name)
 
 
-def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
-              fields: Optional[List[str]], abs_off: int, cfg) -> None:
+def _pipeline(store, ds, name: str, chunks_q, pool, commit_pool,
+              n_threads: int, fields: Optional[List[str]], abs_off: int,
+              cfg) -> None:
     """Split the byte stream into row-aligned blocks, parse them on the
     pool, append + commit in source order."""
     from collections import deque
@@ -393,6 +419,24 @@ def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
     commit_every = cfg.ingest_commit_bytes
     target = None                # block byte size; set once header is known
 
+    # Single-slot asynchronous committer: a commit (journal fsync +
+    # metadata write + replica mirror) runs on its own thread while the
+    # caller keeps splitting/appending the next blocks — disk durability
+    # no longer serializes against network fetch and parsing. ONE
+    # in-flight commit at a time (a one-block handoff): submitting the
+    # next waits on — and propagates any error from — the previous, so
+    # commits stay ordered and a failure surfaces at the very next
+    # drain instead of silently accumulating unjournaled data. The pool
+    # is created by _run_ingest, whose finally joins it even when the
+    # split/parse loop raises mid-stream.
+    commit_fut = None
+
+    def commit_async() -> None:
+        nonlocal commit_fut
+        if commit_fut is not None:
+            commit_fut.result()
+        commit_fut = commit_pool.submit(store.save, name)
+
     def drain_one() -> None:
         nonlocal pending_bytes
         fut, src_end, _ = pending.popleft()
@@ -400,7 +444,7 @@ def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
         pending_bytes += _append_parsed(ds, parsed, src_end)
         if cfg.persist and (not commit_every
                             or pending_bytes >= commit_every):
-            store.save(name)
+            commit_async()
             pending_bytes = 0
 
     def read_more() -> bool:
@@ -501,6 +545,12 @@ def _pipeline(store, ds, name: str, chunks_q, pool, n_threads: int,
             break
     while pending:
         drain_one()
+    if commit_fut is not None:
+        # Join (and propagate) the handed-off commit before the final
+        # synchronous save — _run_ingest's finish must see every chunk
+        # journaled.
+        commit_fut.result()
+        commit_fut = None
     if cfg.persist:
         store.save(name)
 

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from learningorchestra_tpu.catalog import readpipe
 from learningorchestra_tpu.catalog.dataset import (
     ChunkCorrupt, Columns, Dataset, Metadata, _fsync_dir, crc32_file,
     rows_from as _rows_from)
@@ -211,6 +212,9 @@ class DatasetStore:
             del self._datasets[name]
             self._mirror_state.pop(name, None)
         path = self._path(name)
+        # Reclaim the dataset's cached chunk reads promptly (keys are
+        # CRC-pinned, so this is about bytes, not correctness).
+        readpipe.invalidate_under(os.path.join(path, "chunks"))
         if os.path.isdir(path):
             shutil.rmtree(path, ignore_errors=True)
         if self.cfg.replica_root:
@@ -282,6 +286,7 @@ class DatasetStore:
         meta.extra["retries"] = int(meta.extra.get("retries", 0) or 0) + 1
         fresh = Dataset(meta)
         path = self._path(name)
+        readpipe.invalidate_under(os.path.join(path, "chunks"))
         shutil.rmtree(os.path.join(path, "chunks"), ignore_errors=True)
         for fn in ("journal.jsonl", "data.parquet"):
             try:
@@ -453,7 +458,8 @@ class DatasetStore:
                   if self.cfg.ram_budget_mb else None)
         ds.attach_storage(os.path.join(path, "chunks"),
                           os.path.join(path, "journal.jsonl"),
-                          ram_budget_bytes=budget)
+                          ram_budget_bytes=budget,
+                          prefetch_chunks=self.cfg.prefetch_chunks)
         name = ds.metadata.name
         ds.set_repair_hook(
             lambda fname, crc, _n=name: self._repair_chunk(_n, fname, crc))
@@ -482,6 +488,12 @@ class DatasetStore:
         shutil.copy2(src, tmp)
         os.replace(tmp, dst)
         _fsync_dir(dst_dir)
+        # The pre-repair file may have been read (and CACHED) after rot
+        # set in — lazy verification only covers the first read, so such
+        # bytes enter the cache under the journal CRC key. Repair is the
+        # one event that proves the old reads can't be trusted: drop
+        # them so the next read re-decodes the verified replica copy.
+        readpipe.invalidate_files([dst])
         self._bump("chunks_repaired")
         return True
 

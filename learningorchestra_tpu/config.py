@@ -61,6 +61,23 @@ class Settings:
     replica_root: str = field(
         default_factory=lambda: _env("LO_TPU_REPLICA_ROOT", "")
     )
+    #: Chunks read ahead of the consumer by the prefetching read pipeline
+    #: (catalog/readpipe.py): while a streaming consumer (iter_chunks /
+    #: snapshot scans) computes on chunk i, a background worker pool
+    #: reads + CRC-verifies + decodes chunks i+1..i+K. 0 disables
+    #: prefetch entirely — the strictly synchronous read path is kept as
+    #: the parity oracle (docs/performance.md).
+    prefetch_chunks: int = field(
+        default_factory=lambda: _env("LO_TPU_PREFETCH_CHUNKS", 2)
+    )
+    #: Byte budget for the host-RAM LRU chunk cache shared across
+    #: passes/datasets: decoded chunk reads are kept keyed by
+    #: (chunk file, journal CRC, field selection) so the second scan of a
+    #: streamed-fit pipeline and repeated histogram/projection calls hit
+    #: warm memory instead of re-reading disk. 0 disables caching.
+    chunk_cache_bytes: int = field(
+        default_factory=lambda: _env("LO_TPU_CHUNK_CACHE_BYTES", 256 << 20)
+    )
     #: Run a full checksum scrub (DatasetStore.scrub) as part of
     #: load_all's recovery scan: every journaled chunk file is re-read
     #: and verified against its journal CRC32, repairing from the

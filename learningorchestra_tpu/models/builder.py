@@ -104,6 +104,13 @@ class ModelBuilder:
         test_ds = self.store.get(test)
         hparams = hparams or {}
         multi = spmd.is_multiprocess()
+        # Read-pipeline traffic of this whole build (streamed-fit scans,
+        # ChunkedDesign shard reads, double-buffered device feeding) —
+        # recorded on the job profile so a cache/prefetch regression
+        # shows up per-job before it shows up as wall-clock.
+        from learningorchestra_tpu.catalog import readpipe
+
+        rp0 = readpipe.snapshot()
 
         pp_meta = None
         streamed = False
@@ -262,10 +269,19 @@ class ModelBuilder:
             reports = self._build_pipelined(classifiers, *stages)
         device_s = {r.kind: r.metrics["device_s"] for r in reports
                     if "device_s" in r.metrics}
-        if device_s:
+        rp1 = readpipe.snapshot()
+        rp_delta = {k: rp1[k] - rp0[k]
+                    for k in ("cache_hits", "cache_misses",
+                              "prefetch_stalls", "prefetched_chunks")}
+        if device_s or any(rp_delta.values()):
             from learningorchestra_tpu.jobs import record_job_profile
 
-            record_job_profile(fit_device_s=device_s)
+            prof: Dict[str, Any] = {}
+            if device_s:
+                prof["fit_device_s"] = device_s
+            if any(rp_delta.values()):
+                prof["read_pipeline"] = rp_delta
+            record_job_profile(**prof)
         return reports
 
     def _build_pipelined(self, classifiers, prep_fit, dispatch_fit,

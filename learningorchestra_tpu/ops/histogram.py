@@ -139,6 +139,16 @@ def histogram_totals(runtime: MeshRuntime, parent_ds, fields: List[str],
     only on the chunk's data. With ``max_chunks`` pinned to a journaled
     snapshot, every process iterates identical chunk boundaries in
     identical order, so the collective programs line up.
+
+    ``iter_chunks`` streams through the prefetching read pipeline: while
+    this loop counts chunk i (host bincount, or device scatter+psum with
+    its blocking result gather), workers read + CRC-verify + decode
+    chunks i+1..i+K — so on the device path the host→device transfer and
+    collective of block i overlap the fetch of block i+1. SPMD-safe:
+    prefetch workers do pure host I/O (no device ops), and chunk order is
+    deterministic regardless of depth, so every pod process still runs
+    the identical collective sequence. Repeated histograms of the same
+    parent hit the shared chunk cache instead of disk.
     """
     totals: Dict[str, Dict] = {f: {} for f in fields}
     for cols in parent_ds.iter_chunks(list(fields), max_chunks=max_chunks):

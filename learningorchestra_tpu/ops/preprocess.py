@@ -529,11 +529,17 @@ def _fit_design_state(snap, fields, label: str, steps, n_rows: int,
     group streams blocks with the group's fully-fitted step prefix
     applied and feeds every member's accumulator from the same block.
     The label vocab (raw label column — no step ever sees it) rides the
-    first pass. ``profile``, when given, receives ``fit_passes``: the
+    first pass. ``profile``, when given, receives ``fit_passes`` — the
     number of full dataset scans the fit cost, also recorded on
-    ``op_timer`` as ``streamed_fit.passes``."""
+    ``op_timer`` as ``streamed_fit.passes`` — plus ``fit_cache_hits`` /
+    ``fit_cache_misses``, the chunk-cache traffic of those scans: the
+    scans run through the prefetching read pipeline, so on a spilled
+    dataset pass 2+ should be (nearly) all hits and *physical* disk
+    reads stay at ~1 scan regardless of the pass count."""
+    from learningorchestra_tpu.catalog import readpipe
     from learningorchestra_tpu.utils.profiling import op_timer
 
+    rp0 = readpipe.snapshot()
     state: Dict[str, Any] = {}
     need_vocab = False
     if label in fields and n_rows:
@@ -568,6 +574,10 @@ def _fit_design_state(snap, fields, label: str, steps, n_rows: int,
     op_timer.record("streamed_fit.passes", float(passes))
     if profile is not None:
         profile["fit_passes"] = passes
+        rp1 = readpipe.snapshot()
+        profile["fit_cache_hits"] = rp1["cache_hits"] - rp0["cache_hits"]
+        profile["fit_cache_misses"] = (rp1["cache_misses"]
+                                       - rp0["cache_misses"])
     return state
 
 

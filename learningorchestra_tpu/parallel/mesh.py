@@ -109,7 +109,8 @@ def shard_rows(mesh: Mesh, arr: np.ndarray) -> Tuple[jax.Array, int]:
     return out, n
 
 
-def shard_chunked(mesh: Mesh, design) -> Tuple[jax.Array, int]:
+def shard_chunked(mesh: Mesh, design,
+                  prefetch: Optional[int] = None) -> Tuple[jax.Array, int]:
     """Row-shard a LAZY design matrix (ops/preprocess.ChunkedDesign
     protocol: ``.shape``/``.dtype``/``.rows(start, stop)``) without ever
     materializing it fully on the host.
@@ -120,7 +121,19 @@ def shard_chunked(mesh: Mesh, design) -> Tuple[jax.Array, int]:
     host-RAM cost divides by process count instead of multiplying
     (VERDICT r4 #1; the reference's executors likewise hold only their
     partitions, model_builder.py:200). Tail padding rows are zeros, masked
-    by ``row < n`` downstream exactly like ``shard_rows``."""
+    by ``row < n`` downstream exactly like ``shard_rows``.
+
+    Device feeding is DOUBLE-BUFFERED (the streamed-fit data path's
+    host→device overlap): the addressable shard ranges are known up
+    front, so a readpipe worker materializes shard i+1's rows from the
+    chunk store while ``device_put`` of shard i runs on the caller
+    thread. At most two shards are ever resident beyond what the device
+    holds — per-process host memory stays O(shard), not O(dataset).
+    ``prefetch=0`` (or a single addressable shard) degenerates to the
+    strictly serial read→put loop, the parity oracle; a range jax
+    requests that was not read ahead (defensive — callback order is
+    expected to follow the addressable-device order) materializes
+    inline."""
     n = int(design.shape[0])
     n_shards = mesh.shape[DATA_AXIS]
     padded_n = n + (-n) % n_shards
@@ -128,10 +141,7 @@ def shard_chunked(mesh: Mesh, design) -> Tuple[jax.Array, int]:
     sharding = NamedSharding(mesh, P(DATA_AXIS, *([None] * len(tail))))
     dtype = np.dtype(getattr(design, "dtype", np.float32))
 
-    def cb(idx):
-        rs = idx[0]
-        start = rs.start or 0
-        stop = padded_n if rs.stop is None else rs.stop
+    def read_range(start: int, stop: int) -> np.ndarray:
         parts = []
         if start < n:
             parts.append(np.ascontiguousarray(
@@ -141,7 +151,73 @@ def shard_chunked(mesh: Mesh, design) -> Tuple[jax.Array, int]:
             parts.append(np.zeros((pad,) + tail, dtype))
         return parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
 
-    out = jax.make_array_from_callback((padded_n,) + tail, sharding, cb)
+    def norm(idx) -> Tuple[int, int]:
+        rs = idx[0]
+        return (rs.start or 0,
+                padded_n if rs.stop is None else rs.stop)
+
+    from learningorchestra_tpu.catalog import readpipe
+
+    # Deduped addressable shard ranges in device order (devices on a >1
+    # model/seq axis replicate a row range; read it once).
+    order: list = []
+    seen = set()
+    for idx in sharding.addressable_devices_indices_map(
+            (padded_n,) + tail).values():
+        key = norm(idx)
+        if key not in seen:
+            seen.add(key)
+            order.append(key)
+    depth = min(2, readpipe.prefetch_depth(prefetch))
+    if depth <= 0 or len(order) <= 1:
+        out = jax.make_array_from_callback(
+            (padded_n,) + tail, sharding,
+            lambda idx: read_range(*norm(idx)))
+        return out, n
+
+    pool = readpipe.pool()
+    state_lock = threading.Lock()
+    pending = list(order)            # ranges not yet submitted
+    futures: dict = {}               # (start, stop) -> Future
+
+    def submit_ahead() -> None:
+        with state_lock:
+            while pending and len(futures) < depth:
+                key = pending.pop(0)
+                futures[key] = pool.submit(read_range, *key)
+
+    submit_ahead()
+
+    def cb(idx):
+        key = norm(idx)
+        with state_lock:
+            fut = futures.pop(key, None)
+        submit_ahead()           # keep the next read in flight while we
+        if fut is None:          # (possibly) block on this one
+            return read_range(*key)
+        if not fut.done():
+            readpipe.bump("prefetch_stalls")
+        try:
+            return fut.result()
+        except BaseException:
+            readpipe.bump("worker_errors")
+            raise
+
+    try:
+        out = jax.make_array_from_callback((padded_n,) + tail, sharding, cb)
+    finally:
+        with state_lock:
+            leftover = list(futures.values())
+            futures.clear()
+            pending.clear()
+        for fut in leftover:
+            fut.cancel()
+        for fut in leftover:
+            if not fut.cancelled():
+                try:
+                    fut.result()
+                except BaseException:  # noqa: BLE001 — result discarded
+                    pass
     return out, n
 
 
@@ -221,7 +297,8 @@ class MeshRuntime:
                 hit = self._transfer_cache.get(key)
             if hit is not None:
                 return hit
-            out = shard_chunked(self.mesh, arr)
+            out = shard_chunked(self.mesh, arr,
+                                prefetch=self.cfg.prefetch_chunks)
             with self._lock:
                 self._transfer_cache[key] = out
 

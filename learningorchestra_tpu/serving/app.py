@@ -364,6 +364,7 @@ class App:
 
         @self._route("GET", "/metrics")
         def metrics(_req):
+            from learningorchestra_tpu.catalog import readpipe
             from learningorchestra_tpu.utils.profiling import op_timer
 
             recs = app.jobs.records()
@@ -373,6 +374,7 @@ class App:
             return 200, {"ops": op_timer.snapshot(),
                          "jobs": by_status,
                          "integrity": app.store.integrity_snapshot(),
+                         "read_pipeline": readpipe.snapshot(),
                          "profile_dir": app.cfg.profile_dir or None}
 
     def _register_images(self, method: str) -> None:

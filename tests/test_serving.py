@@ -279,6 +279,13 @@ def test_trained_model_registry_routes(served):
     metrics = requests.get(ctx.url("/metrics")).json()
     assert metrics["ops"]["fit.lr"]["count"] >= 1
     assert metrics["jobs"].get("done", 0) >= 1
+    # The chunk-read pipeline's counters ride /metrics (PR 5): cache
+    # traffic, prefetch stalls, worker errors — docs/observability.md.
+    rp = metrics["read_pipeline"]
+    for key in ("cache_hits", "cache_misses", "cache_evictions",
+                "cache_bytes", "cache_entries", "prefetch_stalls",
+                "prefetched_chunks", "worker_errors"):
+        assert key in rp
 
 
 def test_client_times_out_on_hung_server():
