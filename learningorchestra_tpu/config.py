@@ -161,6 +161,42 @@ class Settings:
         default_factory=lambda: _env("LO_TPU_IMAGE_ROOT", "/tmp/lo_tpu_images")
     )
 
+    # --- online inference (serving/batcher.py, models/aot.py) --------------
+    #: Largest coalesced micro-batch (rows) per device dispatch of the
+    #: online predict tier — also the top of the AOT padding-bucket
+    #: ladder (1/8/64/…/max), so raising it adds compiled programs per
+    #: model. Requests carrying more rows than this are rejected 406;
+    #: the client SDK splits client-side (Model.predict_online).
+    serve_max_batch: int = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_MAX_BATCH", 256)
+    )
+    #: Bound (rows) on each model's predict queue. A request that would
+    #: push the queue past this answers 503 + Retry-After — backpressure
+    #: the stock client's jittered backoff already honors. 0 disables
+    #: the online tier entirely (every /predict answers 503).
+    serve_queue_depth: int = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_QUEUE_DEPTH", 1024)
+    )
+    #: Optional coalescing linger (milliseconds): after picking up the
+    #: first waiting request, the dispatcher may wait this long for more
+    #: rows before dispatching a non-full batch. Default 0 — dispatch
+    #: immediately: continuous batching coalesces on its own because the
+    #: queue refills while the device runs the previous batch, and a
+    #: linger just adds its full length to every batch's latency
+    #: whenever traffic can't fill ``serve_max_batch`` within it
+    #: (measured: a 2 ms linger cost a 24-worker closed loop ~10x
+    #: throughput). Raise it only for sparse open-loop traffic where
+    #: trading p50 for occupancy is explicitly wanted.
+    serve_max_wait_ms: float = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_MAX_WAIT_MS", 0.0)
+    )
+    #: How long a queued request may wait for its batch result before
+    #: answering 503 (dispatcher wedged / overloaded) — bounds handler
+    #: threads the same way http_timeout_s bounds the socket.
+    serve_timeout_s: float = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_TIMEOUT_S", 30.0)
+    )
+
     # --- training ----------------------------------------------------------
     #: Max concurrently running model fits (reference: 5 classifiers through
     #: a ThreadPoolExecutor + Spark FAIR pool, model_builder.py:95,160-176).

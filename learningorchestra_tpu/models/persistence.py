@@ -45,6 +45,29 @@ class ModelRegistry:
         # may arrive relative via LO_TPU_STORE_ROOT.
         self.root = os.path.abspath(os.path.join(cfg.store_root, "_models"))
         self._lock = threading.Lock()
+        self._recover_interrupted_saves()
+
+    def _recover_interrupted_saves(self) -> None:
+        """A crash between save()'s two swap renames leaves the live dir
+        missing with the previous version parked at ``.old.<name>`` —
+        promote it back, so a durably-saved model never 404s after a
+        restart (the crash-recovery discipline the chunk store already
+        follows). Leftover ``.tmp.<name>`` staging (crash mid-write, or
+        mid-swap once its ``.old.`` source is promoted) is garbage."""
+        if not os.path.isdir(self.root):
+            return
+        for entry in os.listdir(self.root):
+            if not entry.startswith(".old."):
+                continue
+            live = os.path.join(self.root, entry[len(".old."):])
+            parked = os.path.join(self.root, entry)
+            if os.path.isdir(live):
+                shutil.rmtree(parked)       # swap completed; stray aside
+            else:
+                os.rename(parked, live)
+        for entry in os.listdir(self.root):
+            if entry.startswith(".tmp."):
+                shutil.rmtree(os.path.join(self.root, entry))
 
     def _dir(self, name: str) -> str:
         validate_name(name)
@@ -65,12 +88,22 @@ class ModelRegistry:
         import jax
 
         params = jax.tree.map(np.asarray, model.params)
+        # Stage the whole new version in a sibling temp dir, then swap by
+        # rename: a re-save (hot-swap) must never leave a window where
+        # the model is missing — the online tier's version()/load() run
+        # concurrently with live /predict traffic, and a transient
+        # ModelNotFound maps to a terminal 404 at the client. Leading
+        # dot keeps stray dirs (crash mid-save) out of list(), which
+        # rejects names not starting with a letter or digit.
+        tmp = os.path.join(self.root, f".tmp.{name}")
+        old = os.path.join(self.root, f".old.{name}")
         with self._lock:
-            if os.path.isdir(d):
-                shutil.rmtree(d)
-            os.makedirs(d)
+            for p in (tmp, old):
+                if os.path.isdir(p):
+                    shutil.rmtree(p)
+            os.makedirs(tmp)
             ocp.PyTreeCheckpointer().save(
-                os.path.join(d, "params"), params)
+                os.path.join(tmp, "params"), params)
             manifest = {
                 "name": name,
                 "kind": model.kind,
@@ -80,12 +113,77 @@ class ModelRegistry:
                 "preprocess": preprocess,
                 "time_created": time.strftime("%Y-%m-%d %H:%M:%S"),
             }
-            with open(os.path.join(d, "manifest.json"), "w") as f:
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
                 json.dump(manifest, f, indent=1)
+            # The swap itself: readers hold the same lock, so the brief
+            # old→aside / tmp→live two-step is invisible to them.
+            man_path = os.path.join(d, "manifest.json")
+            prev = None
+            if os.path.isdir(d):
+                try:
+                    pst = os.stat(man_path)
+                    prev = (pst.st_mtime_ns, pst.st_size)
+                except OSError:
+                    pass
+                os.rename(d, old)
+            os.rename(tmp, d)
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            # version() tokens on (mtime_ns, size); on filesystems with
+            # coarse timestamps a fast re-save can land the same token
+            # and the online tier would silently keep serving the OLD
+            # params. Enforce strictly-INCREASING mtime across saves
+            # (not mere inequality with the previous token — that
+            # allows an ABA collision where save3 lands save1's token
+            # while the cache still holds save1's params).
+            try:
+                st = os.stat(man_path)
+                if prev is not None and st.st_mtime_ns <= prev[0]:
+                    os.utime(man_path,
+                             ns=(st.st_atime_ns, prev[0] + 1))
+            except OSError:
+                pass
 
     # -- read ----------------------------------------------------------------
 
+    def version(self, name: str) -> Tuple[int, int]:
+        """Cheap staleness token for the persisted model: the manifest
+        file's (mtime_ns, size). ``save`` rewrites the manifest, so any
+        re-fit under the same name changes the token — what the online
+        tier's AOT program cache keys on (models/aot.py) to hot-swap a
+        re-saved model without a restart. Raises ModelNotFound when the
+        model is gone."""
+        path = os.path.join(self._dir(name), "manifest.json")
+        # Lock-free stat on the hot path (one call per /predict): taking
+        # the registry lock here would head-of-line-block every online
+        # request behind any in-flight save's orbax write. The stat can
+        # only miss an existing model while a save holds the lock
+        # mid-swap — so on miss, wait the swap out and re-check before
+        # concluding ModelNotFound.
+        try:
+            st = os.stat(path)
+        except OSError:
+            with self._lock:
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    raise ModelNotFound(name) from None
+        return (st.st_mtime_ns, st.st_size)
+
     def manifest(self, name: str) -> Dict[str, Any]:
+        # Same lock-free-read / locked-recheck shape as version():
+        # manifests are only ever swapped in whole by rename, so a
+        # plain open() sees the old or the new file, never a torn one —
+        # only the mid-swap missing-file window needs to wait out the
+        # save (taking the lock unconditionally would stall listing and
+        # batch predicts behind a seconds-long orbax write).
+        try:
+            return self._read_manifest(name)
+        except ModelNotFound:
+            with self._lock:
+                return self._read_manifest(name)
+
+    def _read_manifest(self, name: str) -> Dict[str, Any]:
         path = os.path.join(self._dir(name), "manifest.json")
         if not os.path.exists(path):
             raise ModelNotFound(name)
@@ -97,9 +195,14 @@ class ModelRegistry:
         import numpy as np
         import orbax.checkpoint as ocp
 
-        man = self.manifest(name)
-        params = ocp.PyTreeCheckpointer().restore(
-            os.path.join(self._dir(name), "params"))
+        # Whole restore under the lock: a save() swapping the dir while
+        # orbax walks the checkpoint files would hand back a torn mix of
+        # versions (or crash on vanished files). Loads happen per model
+        # (re)load, not per request, so the exclusion is cheap.
+        with self._lock:
+            man = self._read_manifest(name)
+            params = ocp.PyTreeCheckpointer().restore(
+                os.path.join(self._dir(name), "params"))
         # Restore to host arrays: orbax would otherwise pin each leaf to
         # the sharding it was saved with, which may mix device placements
         # (and may not exist on the restoring topology at all). Predict

@@ -24,6 +24,13 @@ CLASSIFIERS: Dict[str, Callable] = {
     "tx": sequence.fit,
 }
 
+#: Families the ONLINE predict tier (models/aot.py, serving/batcher.py)
+#: serves: every continuous-feature family. "tx" is excluded — it
+#: consumes token sequences, so inline JSON feature rows are
+#: out-of-domain for it (its serving story is the batch predictions
+#: route).
+ONLINE_KINDS = ("lr", "nb", "dt", "rf", "gb", "mlp")
+
 
 def get_trainer(name: str) -> Callable:
     try:

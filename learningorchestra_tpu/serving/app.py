@@ -34,6 +34,8 @@ from learningorchestra_tpu.ops.histogram import create_histogram
 from learningorchestra_tpu.ops.projection import create_projection
 from learningorchestra_tpu.parallel import distributed, spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.serving.batcher import (
+    BatcherStopped, PredictBatcher, PredictTimeout, QueueFull)
 from learningorchestra_tpu.serving.http import (
     FileResponse, HtmlResponse, HttpError, IdempotencyCache, Router, Server)
 from learningorchestra_tpu.viz.service import (
@@ -62,6 +64,11 @@ class App:
                 lambda rname=rname: resume_ingest(self.store, rname,
                                                   self.cfg))
         self.builder = ModelBuilder(self.store, self.runtime, self.cfg)
+        # The online inference tier: request handlers are thin
+        # enqueue/await shims into this worker, which owns the device
+        # (serving/batcher.py). Shares the builder's model registry, so
+        # a fresh fit is immediately servable.
+        self.predictor = PredictBatcher(self.builder.registry, self.cfg)
         self.images = {m: ImageService(m, self.cfg) for m in ("tsne", "pca")}
         #: POST replay cache: a create retried with the same
         #: Idempotency-Key (the client SDK sends one per logical create)
@@ -79,18 +86,36 @@ class App:
 
     # -- helpers -------------------------------------------------------------
 
-    def _wrap(self, fn):
+    def _wrap(self, fn, replay_posts: bool = True):
         """Translate domain exceptions to the reference's status codes.
 
         The conversion runs INSIDE the idempotency replay boundary: a
         duplicate create replays the first attempt's mapped status
         (e.g. 409), never a generic 500 wrapper around the raw domain
-        exception.
+        exception. ``replay_posts=False`` exempts a POST route from the
+        replay cache entirely — the online ``/predict`` endpoint is
+        read-like (it creates nothing), so a retried request must hit
+        the model again, never replay a cached response.
         """
 
         def convert(req):
             try:
                 return fn(req)
+            except QueueFull as e:
+                # Predict queue at capacity: backpressure, not failure.
+                # Retry-After + 503 is the contract the client's
+                # jittered backoff already honors (PR 2/PR 4).
+                raise HttpError(
+                    503, str(e),
+                    headers={"Retry-After":
+                             str(max(1, int(e.retry_after_s)))})
+            except PredictTimeout as e:
+                raise HttpError(503, str(e), headers={"Retry-After": "5"})
+            except BatcherStopped as e:
+                # A request raced the model's dispatcher teardown (DELETE
+                # or shutdown): transient — the retry gets the terminal
+                # answer (404 if deleted, a fresh dispatcher otherwise).
+                raise HttpError(503, str(e), headers={"Retry-After": "1"})
             except ChunkCorrupt as e:
                 # Integrity failure the replica couldn't heal: a precise
                 # 500 naming the chunk/checksums, not a parse traceback.
@@ -115,7 +140,7 @@ class App:
                 raise HttpError(406, str(e))
 
         def inner(req):
-            if req.method == "POST":
+            if req.method == "POST" and replay_posts:
                 key = req.header("Idempotency-Key")
                 # Key scoped per path: a client reusing one key against a
                 # different endpoint must not replay the wrong response.
@@ -126,9 +151,10 @@ class App:
 
         return inner
 
-    def _route(self, method: str, pattern: str):
+    def _route(self, method: str, pattern: str, replay_posts: bool = True):
         def deco(fn):
-            return self.router.route(method, pattern)(self._wrap(fn))
+            return self.router.route(method, pattern)(
+                self._wrap(fn, replay_posts=replay_posts))
 
         return deco
 
@@ -276,7 +302,25 @@ class App:
         @self._route("DELETE", "/trained-models/{name}")
         def delete_trained_model(req):
             app.builder.registry.delete(req.params["name"])
+            # Compiled predict programs for the deleted model are stale;
+            # the next /predict re-stats the manifest and 404s cleanly.
+            app.predictor.invalidate(req.params["name"])
             return 200, {"result": "deleted"}
+
+        # ---- online inference (the request/response path the reference
+        # never had: predictions only ever materialized as batch jobs).
+        # NOT idempotency-replayed: /predict is read-like — two identical
+        # POSTs must both hit the model, never a cached response.
+        @self._route("POST", "/trained-models/{name}/predict",
+                     replay_posts=False)
+        def model_predict_online(req):
+            spmd.require_pod_health()
+            (rows,) = req.require("rows")
+            # Thin enqueue/await shim: feature prep runs here on the
+            # handler thread; the per-model dispatcher thread coalesces
+            # concurrent requests into one padded AOT device dispatch
+            # and scatters the rows back (serving/batcher.py).
+            return 200, app.predictor.predict(req.params["name"], rows)
 
         @self._route("POST", "/trained-models/{name}/predictions")
         def model_predict(req):
@@ -360,7 +404,8 @@ class App:
             info["mesh_epoch"] = spmd.mesh_epoch()
             info["pod_error"] = spmd.pod_error()
             return 200, HtmlResponse(render_status(
-                info, app.jobs.records(), app.store.metadata_docs()))
+                info, app.jobs.records(), app.store.metadata_docs(),
+                serving=app.predictor.snapshot()))
 
         @self._route("GET", "/metrics")
         def metrics(_req):
@@ -375,6 +420,7 @@ class App:
                          "jobs": by_status,
                          "integrity": app.store.integrity_snapshot(),
                          "read_pipeline": readpipe.snapshot(),
+                         "serving": app.predictor.snapshot(),
                          "profile_dir": app.cfg.profile_dir or None}
 
     def _register_images(self, method: str) -> None:
@@ -505,6 +551,10 @@ class App:
     def serve(self, background: bool = False) -> Server:
         server = Server(self.router, self.cfg.host, self.cfg.port,
                         request_timeout_s=self.cfg.http_timeout_s)
+        # Stopping the server stops the predict dispatcher threads too
+        # (queued requests fail fast instead of waiting out their
+        # timeout against a dead worker).
+        server.on_stop(self.predictor.stop)
         if background:
             return server.start_background()
         server.serve_forever()

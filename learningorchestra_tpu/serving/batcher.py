@@ -1,0 +1,443 @@
+"""Continuous micro-batching for the online predict tier.
+
+The request-handler + batcher-worker split: HTTP handler threads are thin
+enqueue/await shims — parse rows, enqueue, block on an event — and ONE
+dispatcher thread per model owns the device. The dispatcher coalesces
+whatever is waiting (up to ``serve_max_batch`` rows, lingering
+``serve_max_wait_ms`` for stragglers when the batch isn't full) into one
+padded AOT dispatch (models/aot.py) and scatters the probability rows
+back to the waiting requests. Per-request device dispatch drowns in
+fixed overhead — the same reason Spark's scheduler batches task rounds
+(PAPERS 1612.01437) and MLlib pipelines its fits (1505.06807); keeping
+the device fed with coalesced batches is what turns a ~100 µs dispatch
+tax per request into a ~100 µs tax per *batch*.
+
+Backpressure: each model's queue is bounded (``serve_queue_depth`` rows).
+A request that would overflow it raises :class:`QueueFull`, which the
+serving layer maps to 503 + Retry-After — the contract the client SDK's
+jittered backoff already honors (PR 2/PR 4), so overload degrades into
+client-side pacing instead of collapse.
+
+Instrumentation feeds the ``serving`` section of ``/metrics`` and the
+status page: per-model and aggregate request/row/batch counts, rejected
+and failed counts, mean batch occupancy (rows per dispatch — the
+batching win, directly), live queue depth, p50/p99 end-to-end latency
+over a sliding window, and QPS over the last ~30 s.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from learningorchestra_tpu.config import Settings, settings as global_settings
+from learningorchestra_tpu.models.aot import AotCache, design_from_rows
+from learningorchestra_tpu.models.persistence import ModelRegistry
+
+#: Latency samples kept per model for the percentile window.
+_LATENCY_WINDOW = 2048
+#: Seconds of request-completion history the QPS figure covers.
+_QPS_WINDOW_S = 30.0
+
+
+class QueueFull(Exception):
+    """The model's predict queue is at capacity — answer 503 and tell the
+    client when to come back."""
+
+    def __init__(self, model: str, depth: int, retry_after_s: float = 1.0):
+        super().__init__(
+            f"predict queue full for model {model} ({depth} rows waiting); "
+            "retry after backoff")
+        self.retry_after_s = retry_after_s
+
+
+class PredictTimeout(Exception):
+    """A queued request outlived ``serve_timeout_s`` without a result."""
+
+
+class BatcherStopped(Exception):
+    """The model's dispatcher was torn down while this request raced it
+    (DELETE of the model, or server shutdown). Transient from the
+    client's view: mapped to 503 + Retry-After, and the retry gets the
+    terminal answer — 404 if the model is gone, a fresh dispatcher if it
+    was re-saved."""
+
+
+class _Pending:
+    """One enqueued request: its design rows, the AOT entry its design
+    was built against, and the slot the dispatcher scatters the result
+    (or error) into."""
+
+    __slots__ = ("X", "entry", "done", "probs", "error", "t_enqueue")
+
+    def __init__(self, X: np.ndarray, entry: Any):
+        self.X = X
+        self.entry = entry
+        self.done = threading.Event()
+        self.probs: Optional[np.ndarray] = None
+        self.error: Optional[Exception] = None
+        self.t_enqueue = time.monotonic()
+
+
+class _Stats:
+    """Lock-protected counters + sliding latency window for one model."""
+
+    def __init__(self):
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batched_rows = 0
+        self.rejected = 0
+        self.timeouts = 0
+        self.errors = 0
+        #: (completion monotonic time, latency seconds) ring.
+        self.latencies: collections.deque = collections.deque(
+            maxlen=_LATENCY_WINDOW)
+
+    def snapshot(self, queue_rows: int) -> Dict[str, Any]:
+        now = time.monotonic()
+        recent = [(t, s) for t, s in self.latencies
+                  if now - t <= _QPS_WINDOW_S]
+        lats = sorted(s for _, s in recent) or sorted(
+            s for _, s in self.latencies)
+        # Divide by the full window once it has rolled over; before that
+        # (young server) by the observed span, floored so one lone
+        # sample can't read as thousands of QPS.
+        span = (_QPS_WINDOW_S if len(recent) < len(self.latencies)
+                else max(now - recent[0][0], 1.0) if recent else None)
+        qps = (len(recent) / span) if recent and span else 0.0
+
+        def pct(p: float) -> Optional[float]:
+            if not lats:
+                return None
+            return round(lats[min(int(p * len(lats)), len(lats) - 1)] * 1e3,
+                         3)
+
+        return {
+            "requests": self.requests,
+            "rows": self.rows,
+            "batches": self.batches,
+            "mean_batch_rows": (round(self.batched_rows / self.batches, 3)
+                                if self.batches else 0.0),
+            "rejected": self.rejected,
+            "timeouts": self.timeouts,
+            "errors": self.errors,
+            "queue_rows": queue_rows,
+            "qps": round(qps, 3),
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+        }
+
+
+class ModelBatcher:
+    """The per-model queue + the dispatcher thread that owns the device."""
+
+    def __init__(self, name: str, cfg: Settings, stats: _Stats):
+        self.name = name
+        self.cfg = cfg
+        self.stats = stats
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._queue_rows = 0
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"lo-predict-{name}")
+        self._thread.start()
+
+    # -- handler side --------------------------------------------------------
+
+    def submit(self, X: np.ndarray, entry: Any) -> np.ndarray:
+        """Enqueue one request's rows and block until its batch lands.
+        ``entry`` is the AOT entry ``X`` was designed against — the
+        dispatcher evaluates through it, never through a fresher one
+        (a hot-swap between preprocessing and dispatch must not run
+        old-state rows through new params). Raises QueueFull at
+        capacity (→ 503 upstream) and re-raises any dispatch-side error
+        on the submitting thread."""
+        n = len(X)
+        with self._cond:
+            if self._stopped:
+                raise BatcherStopped(
+                    f"predict dispatcher for model {self.name} stopped")
+            depth = int(self.cfg.serve_queue_depth)
+            if self._queue_rows + n > depth:
+                with _stats_lock:
+                    self.stats.rejected += 1
+                raise QueueFull(self.name, self._queue_rows)
+            pending = _Pending(X, entry)
+            self._queue.append(pending)
+            self._queue_rows += n
+            self._cond.notify_all()
+        if not pending.done.wait(float(self.cfg.serve_timeout_s)):
+            # Withdraw the dead request: if it is still queued, the
+            # device must not burn a dispatch computing rows nobody
+            # will read (the 503'd client is already re-sending them).
+            # Already-taken requests compute wastefully once — bounded.
+            with self._cond:
+                try:
+                    self._queue.remove(pending)
+                    self._queue_rows -= n
+                except ValueError:
+                    pass                    # dispatcher already took it
+            with _stats_lock:
+                self.stats.timeouts += 1
+            raise PredictTimeout(
+                f"predict timed out after {self.cfg.serve_timeout_s}s "
+                f"queued on model {self.name}")
+        if pending.error is not None:
+            raise pending.error
+        lat = time.monotonic() - pending.t_enqueue
+        with _stats_lock:
+            self.stats.requests += 1
+            self.stats.rows += n
+            self.stats.latencies.append((time.monotonic(), lat))
+        return pending.probs
+
+    def queue_rows(self) -> int:
+        with self._cond:
+            return self._queue_rows
+
+    # -- worker side ---------------------------------------------------------
+
+    def _take_batch(self) -> List[_Pending]:
+        """Pop up to ``serve_max_batch`` rows' worth of waiting requests,
+        lingering up to ``serve_max_wait_ms`` for a fuller batch. Whole
+        requests only — a single request never splits across dispatches,
+        so scatter-back is a simple offset walk."""
+        max_rows = max(1, int(self.cfg.serve_max_batch))
+        with self._cond:
+            # Plain wait: submit() and stop() both notify under the
+            # cond, so an idle dispatcher sleeps silently instead of
+            # polling.
+            while not self._queue and not self._stopped:
+                self._cond.wait()
+            if self._stopped and not self._queue:
+                return []
+            deadline = (time.monotonic()
+                        + float(self.cfg.serve_max_wait_ms) / 1e3)
+            # _queue_rows is maintained by submit/_take_batch/timeout
+            # withdrawal under this cond — O(1) vs re-walking the deque
+            # on every linger wakeup.
+            while self._queue_rows < max_rows and not self._stopped:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch: List[_Pending] = []
+            rows = 0
+            while self._queue and rows + len(self._queue[0].X) <= max_rows:
+                p = self._queue.popleft()
+                rows += len(p.X)
+                batch.append(p)
+            if not batch and self._queue:
+                # Head request alone exceeds max_batch (only possible if
+                # someone shrank serve_max_batch at runtime): dispatch it
+                # solo; aot.predict chunks it across max-bucket calls.
+                batch.append(self._queue.popleft())
+                rows = len(batch[0].X)
+            self._queue_rows -= rows
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                # Empty means stopped-and-drained OR a timeout
+                # withdrawal emptied the queue during the linger wait —
+                # only the former ends the thread (a dead dispatcher
+                # with _stopped False would black-hole the model).
+                if self._stopped:
+                    return
+                continue
+            # Group by the entry captured at enqueue: requests that
+            # straddle a hot-swap evaluate through the version their
+            # design matrix was built for (mixing would run old-state
+            # rows through new params — silently wrong numbers, or a
+            # width mismatch erroring innocent co-batched requests).
+            # One dispatch per group; mixed-version batches only occur
+            # in the swap instant itself.
+            groups: Dict[int, List[_Pending]] = {}
+            for p in batch:
+                groups.setdefault(id(p.entry), []).append(p)
+            for grp in groups.values():
+                try:
+                    X = (grp[0].X if len(grp) == 1
+                         else np.concatenate([p.X for p in grp], axis=0))
+                    probs = grp[0].entry.predict(X)
+                    off = 0
+                    for p in grp:
+                        p.probs = probs[off:off + len(p.X)]
+                        off += len(p.X)
+                    with _stats_lock:
+                        self.stats.batches += 1
+                        self.stats.batched_rows += off
+                except Exception as exc:  # noqa: BLE001 — scattered per req
+                    with _stats_lock:
+                        self.stats.errors += len(grp)
+                    for p in grp:
+                        p.error = exc
+                finally:
+                    for p in grp:
+                        p.done.set()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+        # Fail anything still queued so no handler thread waits out its
+        # full timeout against a dead worker.
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._queue_rows = 0
+        for p in leftovers:
+            p.error = BatcherStopped(
+                f"predict dispatcher for model {self.name} stopped")
+            p.done.set()
+
+
+#: One lock for all stats mutation — counters are tiny and contention is
+#: request-rate, not row-rate.
+_stats_lock = threading.Lock()
+
+
+class PredictBatcher:
+    """The serving facade: per-model batchers created lazily, shared AOT
+    cache, aggregate metrics. Held by the App; handlers call
+    :meth:`predict` and everything else is internal."""
+
+    def __init__(self, registry: ModelRegistry,
+                 cfg: Optional[Settings] = None):
+        self.cfg = cfg or global_settings
+        self.aot = AotCache(registry, self.cfg)
+        self._lock = threading.Lock()
+        self._batchers: Dict[str, ModelBatcher] = {}
+        self._stats: Dict[str, _Stats] = {}
+        self._stopped = False
+
+    def _batcher(self, name: str) -> ModelBatcher:
+        with self._lock:
+            if self._stopped:
+                # A handler racing Server.stop() must not resurrect a
+                # dispatcher thread nothing will ever stop again.
+                raise BatcherStopped(
+                    f"predict tier stopped; model {name} not served")
+            b = self._batchers.get(name)
+            if b is None:
+                # Re-validate before spawning a dispatcher: a request
+                # racing DELETE can reach here after invalidate()
+                # already tore the batcher down — without this check it
+                # would resurrect a dispatcher thread for a model that
+                # can never serve again.
+                self.aot.registry.version(name)   # ModelNotFound → 404
+                stats = self._stats.setdefault(name, _Stats())
+                b = ModelBatcher(name, self.cfg, stats)
+                self._batchers[name] = b
+            return b
+
+    def predict(self, name: str, rows: Sequence[Any]) -> Dict[str, Any]:
+        """The whole handler shim: rows → design matrix (host-side, on
+        the handler thread so feature prep overlaps other models'
+        device work) → enqueue/await → JSON-able result."""
+        if int(self.cfg.serve_queue_depth) <= 0:
+            # Existence check BEFORE creating a stats slot: _stats
+            # entries are permanent (invalidate() keeps them for
+            # /metrics continuity), so minting one per client-supplied
+            # name would let a scanner grow this dict — and /metrics —
+            # without bound. Unknown models 404 here like everywhere
+            # else; real ones count the rejection below.
+            self.aot.registry.version(name)   # ModelNotFound → 404
+            # Count the rejection: a tier bouncing 100% of traffic must
+            # show it on /metrics, not read as zero rejections.
+            with self._lock:
+                stats = self._stats.setdefault(name, _Stats())
+            with _stats_lock:
+                stats.rejected += 1
+            raise QueueFull(name, 0)
+        # Load/compile (and 404/406) BEFORE enqueueing: a bad model name
+        # must not cost a queue slot, and first-touch compile happens on
+        # the handler thread instead of stalling the dispatch loop.
+        entry = self.aot.entry(name)
+        # Shape-check the body before len()/preprocessing: {"rows":
+        # null} or a scalar must 406 like every other malformed input,
+        # not 500 on a TypeError.
+        if not isinstance(rows, (list, tuple)):
+            raise ValueError(
+                "rows must be a non-empty JSON array of feature rows")
+        # Cap check BEFORE preprocessing: the client's cap-discovery
+        # probe deliberately oversends and expects a cheap 406 — don't
+        # vocab-encode/fillna 256 rows just to throw them away. The cap
+        # folds in serve_queue_depth: a request bigger than the whole
+        # queue can NEVER be accepted, so it must get this terminal 406
+        # (whose cap the client re-splits to) rather than burn its
+        # retry budget on guaranteed QueueFull 503s.
+        cap = min(int(self.cfg.serve_max_batch),
+                  int(self.cfg.serve_queue_depth))
+        if len(rows) > cap:
+            raise ValueError(
+                f"request carries {len(rows)} rows; per-request cap is "
+                f"serve_max_batch={cap} — split client-side "
+                "(Model.predict_online does)")
+        X = design_from_rows(rows, entry.preprocess)
+        probs = self._batcher(name).submit(X, entry)
+        # .tolist() (C-speed) — this runs per request on the hot path.
+        return {
+            "model": name,
+            "kind": entry.kind,
+            "predictions": np.argmax(probs, axis=1).tolist(),
+            # tolist() on float32 already widens to exact Python floats
+            # — an astype(float64) first would copy for identical JSON.
+            "probabilities": probs.tolist(),
+        }
+
+    def invalidate(self, name: Optional[str] = None) -> None:
+        """Drop compiled programs (and the dispatcher thread) for a
+        deleted/re-saved model; stats survive so /metrics history does
+        not reset."""
+        self.aot.invalidate(name)
+        with self._lock:
+            if name is None:
+                doomed = list(self._batchers.values())
+                self._batchers.clear()
+            else:
+                b = self._batchers.pop(name, None)
+                doomed = [b] if b is not None else []
+        for b in doomed:
+            b.stop()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            names = list(self._stats)
+            queue = {n: (self._batchers[n].queue_rows()
+                         if n in self._batchers else 0) for n in names}
+        with _stats_lock:
+            models = {n: self._stats[n].snapshot(queue[n]) for n in names}
+        agg: Dict[str, Any] = {
+            "requests": sum(m["requests"] for m in models.values()),
+            "rows": sum(m["rows"] for m in models.values()),
+            "batches": sum(m["batches"] for m in models.values()),
+            "rejected": sum(m["rejected"] for m in models.values()),
+            "timeouts": sum(m["timeouts"] for m in models.values()),
+            "errors": sum(m["errors"] for m in models.values()),
+            "queue_rows": sum(m["queue_rows"] for m in models.values()),
+            "qps": round(sum(m["qps"] for m in models.values()), 3),
+        }
+        batches = agg["batches"]
+        agg["mean_batch_rows"] = (
+            round(sum(m["mean_batch_rows"] * m["batches"]
+                      for m in models.values()) / batches, 3)
+            if batches else 0.0)
+        return {**agg, "aot": self.aot.snapshot(), "models": models}
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.stop()

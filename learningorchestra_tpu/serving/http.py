@@ -281,6 +281,13 @@ class Server:
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._stop_callbacks: List[Callable[[], None]] = []
+
+    def on_stop(self, fn: Callable[[], None]) -> None:
+        """Register a teardown hook run by :meth:`stop` — the app wires
+        its background workers (the predict batcher's dispatcher
+        threads) here so stopping the server stops them too."""
+        self._stop_callbacks.append(fn)
 
     def start_background(self) -> "Server":
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -293,4 +300,14 @@ class Server:
 
     def stop(self) -> None:
         self.httpd.shutdown()
+        # Teardown hooks run BEFORE server_close(): ThreadingHTTPServer
+        # joins in-flight handler threads on close (block_on_close), and
+        # handlers may be blocked awaiting a batcher result — stopping
+        # the workers first fails those requests fast instead of
+        # stalling shutdown behind their full serve timeout.
+        for fn in self._stop_callbacks:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                traceback.print_exc()
         self.httpd.server_close()

@@ -12,7 +12,7 @@ the deployment story is "one binary" (SURVEY.md §7).
 from __future__ import annotations
 
 from html import escape
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 _STYLE = """
 body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a2e;
@@ -48,9 +48,37 @@ def _table(headers: List[str], rows: List[List[str]]) -> str:
     return f"<table><tr>{head}</tr>{body}</table>"
 
 
+def _serving_section(serving: Optional[Dict[str, Any]]) -> str:
+    """The online-inference panel: queue depth, p99, QPS per model — so
+    backpressure is visible at a glance without curling /metrics."""
+    if not serving:
+        return ""
+    agg = "".join(
+        f'<span class="kv"><b>{escape(str(k))}</b> {escape(str(v))}</span>'
+        for k, v in serving.items()
+        if k not in ("models", "aot") and v is not None)
+    rows = []
+    for name, m in sorted((serving.get("models") or {}).items()):
+        rows.append([
+            escape(str(name)),
+            escape(str(m.get("requests", 0))),
+            escape(str(m.get("qps", 0))),
+            escape(str(m.get("mean_batch_rows", 0))),
+            escape(str(m.get("queue_rows", 0))),
+            escape("" if m.get("p50_ms") is None else str(m["p50_ms"])),
+            escape("" if m.get("p99_ms") is None else str(m["p99_ms"])),
+            escape(str(m.get("rejected", 0))),
+        ])
+    table = _table(["model", "requests", "qps", "rows/batch", "queue",
+                    "p50 (ms)", "p99 (ms)", "rejected (503)"], rows)
+    return (f"<h2>Online predict ({len(rows)} models)</h2>"
+            f"<p>{agg}</p>{table}")
+
+
 def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
                   datasets: List[Dict[str, Any]],
-                  refresh_seconds: int = 5) -> str:
+                  refresh_seconds: int = 5,
+                  serving: Optional[Dict[str, Any]] = None) -> str:
     """Render the operator page. Inputs are exactly what the JSON routes
     return, so the page can never disagree with the API."""
     mesh = cluster.get("mesh") or {}
@@ -93,6 +121,7 @@ def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
 <body>
 <h1>learningorchestra-tpu — cluster status</h1>
 <p>{cluster_kvs}<span class="kv"><b>mesh</b> {mesh_txt}</span></p>
+{_serving_section(serving)}
 <h2>Jobs ({len(jobs)})</h2>
 {_table(["job", "kind", "target datasets", "status", "runtime (s)",
          "error"], job_rows)}

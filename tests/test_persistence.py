@@ -101,3 +101,37 @@ def test_op_timer_records_fits(built):
     snap = op_timer.snapshot()
     assert snap["fit.lr"]["count"] >= 1
     assert snap["fit.lr"]["total_s"] > 0
+
+
+def test_interrupted_hot_swap_recovers_on_init(built):
+    """A crash between save()'s two swap renames (live dir parked at
+    .old.<name>, new version still staged at .tmp.<name>) must not lose
+    the durably-saved model: a fresh registry promotes the parked
+    version back and clears the staging dirs (review finding)."""
+    import os
+    import shutil
+
+    mb, _ = built
+    reg = mb.registry
+    d = os.path.join(reg.root, "ptm_lr")
+    old = os.path.join(reg.root, ".old.ptm_lr")
+    tmp = os.path.join(reg.root, ".tmp.ptm_lr")
+    want = reg.manifest("ptm_lr")
+    # Simulate the mid-swap crash state.
+    shutil.copytree(d, tmp)
+    os.rename(d, old)
+    assert not os.path.isdir(d)
+
+    reg2 = ModelRegistry(mb.cfg)
+    assert reg2.exists("ptm_lr")
+    assert reg2.manifest("ptm_lr") == want
+    assert not os.path.isdir(old) and not os.path.isdir(tmp)
+    man, model = reg2.load("ptm_lr")        # checkpoint restores cleanly
+    assert man["kind"] == "lr"
+    # Completed-swap stray: .old left behind AFTER the new version went
+    # live must be cleaned, not promoted over it.
+    shutil.copytree(os.path.join(reg2.root, "ptm_dt"),
+                    os.path.join(reg2.root, ".old.ptm_dt"))
+    reg3 = ModelRegistry(mb.cfg)
+    assert reg3.manifest("ptm_dt") == reg2.manifest("ptm_dt")
+    assert not os.path.isdir(os.path.join(reg3.root, ".old.ptm_dt"))

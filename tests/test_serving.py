@@ -324,14 +324,16 @@ def test_client_retries_connection_errors():
     # nothing listens on this port: immediate connection refusal
     dead = Context("http://127.0.0.1:1", retries=2, backoff_seconds=0.01)
     calls = []
-    orig = requests.request
+    orig = requests.Session.request
 
-    def counting(method, url, **kw):
+    def counting(self, method, url, **kw):
         calls.append((method, (kw.get("headers") or {}).get(
             "Idempotency-Key")))
-        return orig(method, url, **kw)
+        return orig(self, method, url, **kw)
 
-    requests.request = counting
+    # The client pools keep-alive Sessions per thread, so the retry
+    # path runs through Session.request, not module-level requests.*.
+    requests.Session.request = counting
     try:
         with pytest.raises(requests.ConnectionError):
             dead.get("/files")
@@ -343,7 +345,7 @@ def test_client_retries_connection_errors():
         keys = {k for _, k in calls}
         assert len(keys) == 1 and None not in keys  # one stable key
     finally:
-        requests.request = orig
+        requests.Session.request = orig
 
 
 def test_client_backoff_capped_jittered_and_total_bounded(monkeypatch):
@@ -376,8 +378,8 @@ def test_client_clamps_retry_after(monkeypatch):
         status_code = 503
         headers = {"Retry-After": "10000"}
 
-    monkeypatch.setattr(client_mod.requests, "request",
-                        lambda *a, **kw: Fake503())
+    monkeypatch.setattr(client_mod.requests.Session, "request",
+                        lambda self, *a, **kw: Fake503())
     sleeps = []
     monkeypatch.setattr(client_mod.time, "sleep",
                         lambda s: sleeps.append(s))
