@@ -42,6 +42,14 @@ HBM, measured 363 s vs 106 s sequential); ``overlap`` reports the
 headline wall-clock against the sum of the same sweep's per-family fit
 times (which exclude scheduler waits by construction), making the
 pipeline win directly falsifiable.
+
+Tree families (PR 7): fits route through the fused Pallas
+binned-histogram kernels by default (``tree_kernel`` in the output
+records the active path); their cost model switches with the path
+(flops.py module docstring — the kernel path is memory-bound, so
+``bw_util`` against peak HBM bandwidth is recorded next to ``mfu``),
+and ``tree_bench`` times the histogram/routing/descent phases on both
+paths separately (LO_BENCH_TREE_ROWS scales or skips it).
 """
 
 from __future__ import annotations
@@ -66,6 +74,11 @@ N_TEST = int(os.environ.get("LO_BENCH_TEST_ROWS", 100_000))
 #: Rows for the chunk-store scan-throughput microbenchmark (PR 5:
 #: prefetching read pipeline + chunk cache); 0 skips it.
 N_SCAN = int(os.environ.get("LO_BENCH_SCAN_ROWS", 4_000_000))
+#: Rows for the tree-kernel phase microbenchmark (PR 7: fused Pallas
+#: binned-histogram kernels) — times the histogram and routing/descent
+#: phases separately on the kernel and XLA-oracle paths, so the record
+#: shows where the tree-family speedup lands; 0 skips it.
+N_TREE = int(os.environ.get("LO_BENCH_TREE_ROWS", 4_000_000))
 
 
 def scan_bench() -> dict:
@@ -154,6 +167,107 @@ def scan_bench() -> dict:
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
+def tree_bench() -> dict:
+    """Phase-level microbenchmark of the tree-fit hot loops: one level's
+    histogram accumulation, one level's routing pass, and a full-tree
+    descent, timed separately on the fused Pallas kernel path and the
+    XLA contraction oracle (LO_TPU_TREE_KERNEL=0 equivalent) over the
+    same HIGGS-shaped inputs — so BENCH/RESULTS.md record *where* the
+    tree-family speedup lands, not just the end-to-end fit_s delta."""
+    import numpy as np
+
+    if N_TREE <= 0:
+        return {}
+    import jax
+    from functools import partial
+
+    from learningorchestra_tpu.models import trees
+    from learningorchestra_tpu.ops import pallas_kernels as pk
+
+    n, d, n_bins, max_depth, S = N_TREE, 28, 32, 5, 2
+    NL = 2 ** (max_depth - 1)
+    M = 2 ** (max_depth + 1) - 1
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, n_bins, (n, d), dtype=np.uint8).astype(np.uint8)
+    stats = rng.random((S, n), dtype=np.float32)
+    rel = rng.integers(0, NL, n).astype(np.int32)
+    active = np.ones(n, bool)
+    assign = (rel + NL - 1).astype(np.int32)
+    best_f = rng.integers(0, d, NL).astype(np.int32)
+    best_t = rng.integers(0, n_bins, NL).astype(np.int32)
+    split = np.ones(NL, bool)
+    feat = rng.integers(0, d, M).astype(np.int32)
+    thr = rng.integers(0, n_bins, M).astype(np.int32)
+    internal = (np.arange(M) < M // 2)
+
+    tile = pk.tree_tile(d, n_bins)
+    blk, nbk, n_pad = trees._block_shape(n, d * n_bins)
+
+    def padded(a, k, axis0=True):
+        pad = [(0, 0)] * a.ndim
+        pad[0 if axis0 else a.ndim - 1] = (0, k - a.shape[0 if axis0 else -1])
+        return np.pad(a, pad)
+
+    n_pad_k = -(-n // tile) * tile
+    hdt = trees._hist_dtype()
+    variants = {}
+    # Same lowering gate the fits use: on a backend whose Mosaic rejects
+    # the kernels the A/B degrades to oracle-only numbers instead of
+    # killing the whole driver run before the sweep even starts.
+    kernel_supported = pk.tree_kernels_supported()
+    if kernel_supported:
+        variants["kernel"] = dict(
+            n_pad=n_pad_k,
+            hist=jax.jit(partial(pk.tree_histogram, n_nodes=NL,
+                                 n_bins=n_bins, tile=tile,
+                                 operand_dtype=hdt)),
+            route=jax.jit(partial(pk.tree_route_level, tile=tile)),
+            descend=jax.jit(partial(pk.tree_descend, max_depth=max_depth)),
+        )
+    variants.update(
+        xla=dict(
+            n_pad=n_pad,
+            hist=jax.jit(partial(trees._hist_level_xla, n_nodes=NL,
+                                 n_bins=n_bins, blk=blk)),
+            route=jax.jit(partial(trees._route_level_xla, blk=blk)),
+            descend=jax.jit(partial(trees._descend, max_depth=max_depth)),
+        ))
+
+    def best_of(f, *args, reps=3):
+        jax.tree.map(lambda a: a.block_until_ready(), f(*args))  # compile
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            out = f(*args)
+            jax.tree.map(lambda a: a.block_until_ready(), out)
+            times.append(time.time() - t0)
+        return min(times)
+
+    doc = {"rows": n, "d": d, "n_bins": n_bins, "tile": tile,
+           "oracle_block": blk, "kernel_supported": kernel_supported}
+    for name, v in variants.items():
+        np_ = v["n_pad"]
+        B_p = padded(codes, np_)
+        stats_p = padded(stats, np_, axis0=False)
+        rel_p, act_p, asg_p = (padded(rel, np_), padded(active, np_),
+                               padded(assign, np_))
+        doc[name] = {
+            "hist_ms": round(1e3 * best_of(
+                v["hist"], B_p, stats_p, rel_p, act_p), 3),
+            "route_ms": round(1e3 * best_of(
+                v["route"], B_p, rel_p, act_p, asg_p, best_f, best_t,
+                split), 3),
+            "descend_ms": round(1e3 * best_of(
+                v["descend"], codes, feat, thr, internal), 3),
+        }
+    if kernel_supported:
+        doc["speedup"] = {
+            k.replace("_ms", ""): round(doc["xla"][k] / doc["kernel"][k], 2)
+            for k in ("hist_ms", "route_ms", "descend_ms")
+            if doc["kernel"][k] > 0}
+    return doc
+
+
 #: Per-family held-out accuracy gates. Floors catch broken fits; the
 #: orderings (every tree family must beat lr) pin the published HIGGS
 #: difficulty structure the workload was calibrated to.
@@ -174,8 +288,13 @@ def main() -> None:
     from learningorchestra_tpu.parallel.mesh import MeshRuntime
 
     from learningorchestra_tpu.models import flops as flops_mod
+    from learningorchestra_tpu.models import trees as trees_mod
 
     scan = scan_bench()
+    tree = tree_bench()
+    #: Which tree-fit path the sweep below actually runs (config flags +
+    #: backend probe) — selects the matching flops/bytes cost model.
+    tree_kernel = trees_mod._use_tree_kernel()
 
     cfg = Settings()
     cfg.persist = False
@@ -220,10 +339,18 @@ def main() -> None:
     check_gates(serial)
     families = {}
     for kind, doc in serial.items():
-        fl = flops_mod.build_flops(kind, N_TRAIN, N_TEST, n_features, 2)
+        fl = flops_mod.build_flops(kind, N_TRAIN, N_TEST, n_features, 2,
+                                   tree_kernel=tree_kernel)
         m = flops_mod.mfu(fl, doc["device_s"])
         families[kind] = dict(doc, flops=fl,
                               mfu=round(m, 6) if m is not None else None)
+        # Tree families are memory-bound on the kernel path (flops.py
+        # module docstring): record the roofline figure that matters.
+        by = flops_mod.fit_bytes(kind, N_TRAIN, n_features, 2,
+                                 tree_kernel=tree_kernel)
+        bw = flops_mod.bw_util(by, doc["device_s"])
+        if bw is not None:
+            families[kind].update(hbm_bytes=by, bw_util=round(bw, 6))
     serial_sum_fit_s = sum(doc["fit_s"] for doc in serial.values())
 
     # Median of 3 measured PIPELINED sweeps: the tunneled test chip adds
@@ -264,7 +391,10 @@ def main() -> None:
             "serialized_sweep_sum_fit_s": round(serial_sum_fit_s, 3),
         },
         "peak_flops": flops_mod.PEAK_FLOPS,
+        "peak_bw": flops_mod.PEAK_BW,
+        "tree_kernel": tree_kernel,
         "scan_bench": scan,
+        "tree_bench": tree,
     }))
 
 

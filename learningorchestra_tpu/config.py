@@ -128,6 +128,16 @@ class Settings:
     use_pallas: bool = field(
         default_factory=lambda: _env("LO_TPU_USE_PALLAS", True, bool)
     )
+    #: Route the tree families' (dt/rf/gb) histogram, routing and descent
+    #: hot loops through the fused Pallas binned-histogram kernels
+    #: (ops/pallas_kernels.py tree_*). ``0`` selects the pure-XLA blocked
+    #: contraction path, kept as the bit-parity oracle
+    #: (docs/performance.md §tree kernels). Subordinate to ``use_pallas``;
+    #: off-TPU the kernels run in interpreter mode so the same code path
+    #: is exercised by the CPU-mesh tests.
+    tree_kernel: bool = field(
+        default_factory=lambda: _env("LO_TPU_TREE_KERNEL", True, bool)
+    )
 
     # --- mesh / parallelism ------------------------------------------------
     #: Mesh axis names. "data" shards rows (the reference's Spark partitioning

@@ -18,6 +18,25 @@ count 1 flop per element (they occupy the VPU exactly like an add);
 terms an order of magnitude below the leading contraction are dropped.
 Shapes/blocking mirror models/logistic.py, models/trees.py,
 models/naive_bayes.py — the line references below.
+
+Tree families carry TWO cost models since the fused Pallas kernel path
+landed (LO_TPU_TREE_KERNEL, models/trees.py):
+
+- The **oracle path** genuinely executes the dense one-hot contraction,
+  so its flops price that emulation (the MXU work the device performs).
+- The **kernel path** prices the *algorithm* — a binned scatter-add is
+  one accumulate per (row, feature, stat) per level — NOT the dense
+  contraction the kernel still uses internally to feed the MXU. The
+  contraction term is ~NL·n_bins (≈512× at the defaults) the
+  algorithmic accumulate — ~97% multiplications by zero — and pricing
+  it would inflate the end-to-end kernel-path numerator ~50× (the bin
+  compares and gain terms are shared by both paths): congratulating the
+  kernel for doing useless work fast is exactly the "bloated lowering
+  hides itself" failure mode above.
+  Kernel-path tree fits are therefore memory-bound by design and their
+  honest utilization figure is ``bw_util`` — modeled HBM bytes
+  (``fit_bytes``) over device time against peak HBM bandwidth — with
+  mfu reported alongside as the (low) MXU-work fraction.
 """
 
 from __future__ import annotations
@@ -26,9 +45,9 @@ import os
 from typing import Any, Dict, Optional
 
 
-def _env_peak() -> float:
+def _env_f(name: str) -> float:
     try:
-        return float(os.environ.get("LO_TPU_PEAK_FLOPS", "") or 0.0)
+        return float(os.environ.get(name, "") or 0.0)
     except ValueError:
         return 0.0
 
@@ -39,28 +58,83 @@ def _env_peak() -> float:
 #: Override with LO_TPU_PEAK_FLOPS for other parts/backends.
 V5E_PEAK_BF16 = 197e12
 
-PEAK_FLOPS = _env_peak() or V5E_PEAK_BF16
+PEAK_FLOPS = _env_f("LO_TPU_PEAK_FLOPS") or V5E_PEAK_BF16
+
+#: Peak HBM bandwidth of one TPU v5e chip (819 GB/s) — the denominator
+#: of ``bw_util`` for memory-bound programs (kernel-path tree fits).
+#: Override with LO_TPU_PEAK_BW.
+V5E_HBM_BW = 819e9
+
+PEAK_BW = _env_f("LO_TPU_PEAK_BW") or V5E_HBM_BW
+
+
+def _tree_kernel_default() -> bool:
+    """Whether the fit programs route through the Pallas tree kernels —
+    mirrors models/trees.py `_use_tree_kernel` (config flags + backend
+    probe) without importing jax at module import time."""
+    from learningorchestra_tpu.models import trees
+
+    return trees._use_tree_kernel()
 
 
 def _tree_build_flops(n: float, d: float, n_bins: float, max_depth: float,
-                      n_stats: float) -> float:
+                      n_stats: float, kernel: bool = False) -> float:
     """One level-wise histogram tree (models/trees.py _build_tree).
 
-    Per level, per row block: the (NL·S, blk) @ (blk, d·n_bins)
-    histogram contraction (trees.py:255) dominates at
-    2·n·NL·S·d·n_bins; building the bin one-hot costs n·d·n_bins
-    compares and the node-masked stats operand n·NL·S. Routing
-    (_sel_col/_sel_table one-hot passes) adds ~n·(2d + 3·NL) per level.
-    NL is the fixed per-level node width 2^(max_depth-1) (trees.py:220).
+    Oracle path — per level, per row block: the (NL·S, blk) @
+    (blk, d·n_bins) histogram contraction (trees.py _hist_level_xla)
+    dominates at 2·n·NL·S·d·n_bins; building the bin one-hot costs
+    n·d·n_bins compares and the node-masked stats operand n·NL·S.
+    Routing (_sel_col/_sel_table one-hot passes) adds ~n·(2d + 3·NL)
+    per level. NL is the fixed per-level node width 2^(max_depth-1).
     Leaf stats add one (S, n) @ (n, M) contraction.
+
+    Kernel path — algorithmic cost only (see module docstring): one
+    accumulate per (row, feature, stat) per level (2·n·d·S), the
+    n·d·n_bins bin compares, ~5·n routing ops per level, the
+    ~6·NL·d·n_bins·S gain evaluation, and n·S leaf accumulates.
     """
     NL = 2 ** max(int(max_depth) - 1, 0)
     M = 2 ** (int(max_depth) + 1) - 1
+    if kernel:
+        per_level = (2.0 * n * d * n_stats            # binned scatter-add
+                     + n * d * n_bins                 # bin one-hot
+                     + 6.0 * NL * d * n_bins * n_stats  # split gains
+                     + 5.0 * n)                       # routing
+        return max_depth * per_level + 2.0 * n * n_stats
     per_level = (2.0 * n * NL * n_stats * d * n_bins   # histogram matmul
                  + n * d * n_bins                      # bin one-hot
                  + n * NL * n_stats                    # stats operand
                  + n * (2.0 * d + 3.0 * NL))           # routing selects
     return max_depth * per_level + 2.0 * n * n_stats * M
+
+
+def _tree_build_bytes(n: float, d: float, n_bins: float, max_depth: float,
+                      n_stats: float, kernel: bool = False) -> float:
+    """Modeled HBM traffic of one tree build (the roofline numerator for
+    the memory-bound kernel path).
+
+    Kernel path — per level the histogram pass streams the uint8 bin
+    matrix (n·d), the f32 stats (4·n·S) and the int32 rel/active columns
+    (~8·n); the routing pass re-streams the bin matrix and
+    reads+writes assignment (~12·n). Accumulator blocks live in VMEM.
+    Leaf pass: stats + assignment once.
+
+    Oracle path adds the materialized contraction operands per level:
+    the (blk, d·n_bins) bin one-hot and the (blk, NL·S) node-masked
+    stats, each written then read (2× each way) at the operand dtype
+    (bf16 on TPU — modeled at 2 bytes).
+    """
+    hist_level = n * (d + 4.0 * n_stats + 8.0)
+    route_level = n * (d + 12.0)
+    leaf = n * (4.0 * n_stats + 4.0)
+    total = max_depth * (hist_level + route_level) + leaf
+    if not kernel:
+        NL = 2 ** max(int(max_depth) - 1, 0)
+        onehot = 2.0 * 2.0 * n * (d * n_bins + NL * n_stats)
+        total += max_depth * onehot + 2.0 * 2.0 * n * (
+            2 ** (int(max_depth) + 1) - 1)
+    return total
 
 
 def _binning_flops(n: float, d: float, n_bins: float) -> float:
@@ -76,11 +150,16 @@ def _descend_flops(n: float, d: float, max_depth: float) -> float:
 
 
 def fit_flops(kind: str, n: int, d: int, num_classes: int,
-              hparams: Optional[Dict[str, Any]] = None) -> float:
+              hparams: Optional[Dict[str, Any]] = None,
+              tree_kernel: Optional[bool] = None) -> float:
     """Analytic FLOPs of one family's *fit* device program on (n, d)
     rows. ``hparams`` are the request's overrides; defaults mirror the
-    trainer signatures (Spark-2.4 parity defaults)."""
+    trainer signatures (Spark-2.4 parity defaults). ``tree_kernel``
+    selects the tree families' cost model (module docstring); None
+    reads the active configuration."""
     hp = dict(hparams or {})
+    if kind in ("dt", "rf", "gb") and tree_kernel is None:
+        tree_kernel = _tree_kernel_default()
     n, d, C = float(n), float(d), float(max(num_classes, 2))
     if kind == "lr":
         solver = hp.get("solver", "auto")
@@ -110,7 +189,8 @@ def fit_flops(kind: str, n: int, d: int, num_classes: int,
         n_bins = float(hp.get("n_bins", 32))
         return (_binning_flops(n, d, n_bins)
                 + n_trees * _tree_build_flops(n, d, n_bins, max_depth,
-                                              n_stats=C))
+                                              n_stats=C,
+                                              kernel=bool(tree_kernel)))
     if kind == "gb":
         n_rounds = float(hp.get("n_rounds", 20))
         max_depth = float(hp.get("max_depth", 5))
@@ -119,7 +199,9 @@ def fit_flops(kind: str, n: int, d: int, num_classes: int,
         # Per round: grad/hess stats ~6·n, one tree build (S=2 stats),
         # leaf-value descent + margin update (~_descend + n·M select).
         M = 2 ** (int(max_depth) + 1) - 1
-        per_round = (_tree_build_flops(n, d, n_bins, max_depth, n_stats=2.0)
+        per_round = (_tree_build_flops(n, d, n_bins, max_depth,
+                                       n_stats=2.0,
+                                       kernel=bool(tree_kernel))
                      + _descend_flops(n, d, max_depth) + n * M + 6.0 * n)
         return boosters * (n_rounds * per_round) + _binning_flops(n, d,
                                                                   n_bins)
@@ -161,10 +243,12 @@ def predict_flops(kind: str, n: int, d: int, num_classes: int,
 
 def build_flops(kind: str, n_train: int, n_test: int, d: int,
                 num_classes: int,
-                hparams: Optional[Dict[str, Any]] = None) -> float:
+                hparams: Optional[Dict[str, Any]] = None,
+                tree_kernel: Optional[bool] = None) -> float:
     """Fit + probability pass — the device program one family contributes
     to a model build (models/builder.py fit device phase)."""
-    return (fit_flops(kind, n_train, d, num_classes, hparams)
+    return (fit_flops(kind, n_train, d, num_classes, hparams,
+                      tree_kernel=tree_kernel)
             + predict_flops(kind, n_test, d, num_classes, hparams))
 
 
@@ -176,3 +260,45 @@ def mfu(flops: float, device_s: float,
     if device_s <= 0.0 or peak <= 0.0 or flops <= 0.0:
         return None
     return flops / (device_s * peak)
+
+
+def fit_bytes(kind: str, n: int, d: int, num_classes: int,
+              hparams: Optional[Dict[str, Any]] = None,
+              tree_kernel: Optional[bool] = None) -> Optional[float]:
+    """Modeled HBM bytes moved by one family's fit device program — the
+    roofline numerator for memory-bound programs. Currently modeled for
+    the tree families only (the ones the Pallas kernel path turned
+    memory-bound); None elsewhere."""
+    if kind not in ("dt", "rf", "gb"):
+        return None
+    hp = dict(hparams or {})
+    if tree_kernel is None:
+        tree_kernel = _tree_kernel_default()
+    n, d, C = float(n), float(d), float(max(num_classes, 2))
+    max_depth = float(hp.get("max_depth", 5))
+    n_bins = float(hp.get("n_bins", 32))
+    binning = 5.0 * n * d                      # read f32, write uint8
+    if kind in ("dt", "rf"):
+        n_trees = float(hp.get("n_trees", 1 if kind == "dt" else 20))
+        return binning + n_trees * _tree_build_bytes(
+            n, d, n_bins, max_depth, n_stats=C, kernel=bool(tree_kernel))
+    n_rounds = float(hp.get("n_rounds", 20))
+    boosters = C if C > 2 else 1.0
+    # Per round: the tree build, full-tree descent (bin matrix + assign),
+    # and the margin/grad/hess elementwise passes (~5 f32 row vectors).
+    per_round = (_tree_build_bytes(n, d, n_bins, max_depth, n_stats=2.0,
+                                   kernel=bool(tree_kernel))
+                 + n * (d + 4.0) + 20.0 * n)
+    return binning + boosters * n_rounds * per_round
+
+
+def bw_util(bytes_moved: Optional[float], device_s: float,
+            peak_bw: float = 0.0) -> Optional[float]:
+    """Achieved fraction of peak HBM bandwidth: bytes / (device_s ·
+    peak). The utilization figure that matters for memory-bound programs
+    (kernel-path tree fits); None when unmodeled or degenerate."""
+    peak = peak_bw or PEAK_BW
+    if bytes_moved is None or device_s <= 0.0 or peak <= 0.0 \
+            or bytes_moved <= 0.0:
+        return None
+    return bytes_moved / (device_s * peak)

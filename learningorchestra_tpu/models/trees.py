@@ -39,9 +39,27 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from learningorchestra_tpu.models.base import TrainedModel, as_design
+from learningorchestra_tpu.ops import pallas_kernels
+from learningorchestra_tpu.parallel import spmd
 from learningorchestra_tpu.parallel.mesh import DATA_AXIS, MeshRuntime
 
 NEG = -1e30
+
+
+def _use_tree_kernel(runtime: Optional[MeshRuntime] = None) -> bool:
+    """Whether tree fits route their hot loops through the fused Pallas
+    kernels (ops/pallas_kernels.py). ``LO_TPU_TREE_KERNEL=0`` selects
+    the pure-XLA contraction path — kept as the bit-parity oracle
+    (docs/performance.md); the master ``LO_TPU_USE_PALLAS`` switch
+    disables every Pallas kernel at once. Off-TPU the kernels run in
+    interpreter mode, so the default exercises the same code path on
+    the CPU mesh."""
+    if runtime is not None:
+        cfg = runtime.cfg
+    else:
+        from learningorchestra_tpu.config import settings as cfg
+    return bool(cfg.use_pallas and cfg.tree_kernel
+                and pallas_kernels.tree_kernels_supported())
 
 
 def _hist_dtype():
@@ -135,6 +153,14 @@ def quantile_edges(X: np.ndarray, n_bins: int,
     return np.ascontiguousarray(edges)
 
 
+def validate_n_bins(n_bins: int) -> None:
+    """Single guard for the uint8 bin-code representation ``bin_features``
+    produces — every tree entry point (edge prep, dt/rf, gb) funnels
+    through it."""
+    if n_bins > 256:
+        raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
+
+
 @jax.jit
 def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
     """float features → uint8 bin codes: code = #edges strictly below x.
@@ -178,8 +204,105 @@ def _block_shape(n, onehot_cols=0):
     return blk, nbk, nbk * blk
 
 
+def _hist_level_xla(B, stats_T, rel, active, *, n_nodes, n_bins, blk):
+    """One level's local (node, feature, bin, stat) histogram via the
+    blocked MXU-contraction emulation — the ``LO_TPU_TREE_KERNEL=0``
+    oracle path.
+
+    The histogram is ONE MXU contraction per block — not scatters (TPU
+    scatter-adds serialize) and not a per-feature matmul loop (n_bins=32
+    lane-pads to 128, NL·S is sublane-starved, and the d-way unroll
+    bloats compile time). The (feature, bin) one-hot packs into a single
+    (blk, d·n_bins) operand so every feature rides the same matmul: A
+    packs node-masked per-row stats (NL·S, blk); one
+    (NL·S, blk) @ (blk, d·n_bins) product per block. Blocks are carved
+    with dynamic_slice inside the scan body (index scan) rather than
+    scanning over a stacked (nbk, blk, ...) operand: XLA:TPU compiles
+    scans over multi-hundred-MB stacked inputs ~30x slower (measured
+    23.5s vs 0.8s for a trivial body at 11 x 1M rows). The one-hot
+    operands materialize in HBM per block — the traffic the Pallas
+    kernel path exists to eliminate.
+    """
+    n_pad, d = B.shape
+    S = stats_T.shape[0]
+    nbk = n_pad // blk
+    bins_u8 = jnp.arange(n_bins, dtype=jnp.uint8)[None, None, :]
+
+    def hist_block(hist, i):
+        Bblk = jax.lax.dynamic_slice_in_dim(B, i * blk, blk)
+        relblk = jax.lax.dynamic_slice_in_dim(rel, i * blk, blk)
+        ablk = jax.lax.dynamic_slice_in_dim(active, i * blk, blk)
+        sblk = jax.lax.dynamic_slice_in_dim(
+            stats_T, i * blk, blk, axis=1)               # (S, blk)
+        node_oh = ((relblk[:, None] == jnp.arange(n_nodes)[None, :])
+                   & ablk[:, None])                      # (blk, NL)
+        # bf16 operands (on TPU) halve the dominant HBM traffic (the
+        # (blk, d·n_bins) one-hot materialization); products of {0,1}
+        # one-hots with bf16-rounded stats are exact, and partial
+        # sums accumulate in f32 via preferred_element_type.
+        hdt = _hist_dtype()
+        A = (node_oh[:, :, None].astype(hdt)
+             * sblk.T.astype(hdt)[:, None, :])           # (blk, NL, S)
+        At = A.reshape(blk, n_nodes * S).T               # (NL·S, blk)
+        oh = (Bblk[:, :, None] == bins_u8).astype(hdt)
+        return hist + jax.lax.dot(
+            At, oh.reshape(blk, d * n_bins),
+            preferred_element_type=jnp.float32), None
+
+    hist, _ = jax.lax.scan(
+        hist_block, jnp.zeros((n_nodes * S, d * n_bins), jnp.float32),
+        jnp.arange(nbk))
+    # (NL·S, d·nb) → (NL, d, bins, S)
+    return hist.reshape(n_nodes, S, d, n_bins).transpose(0, 2, 3, 1)
+
+
+def _route_level_xla(B, rel, active, assign, best_f, best_t, split, *,
+                     blk):
+    """One level's routing pass (oracle path): rows of split nodes go to
+    their children, leaf rows keep their node. Blocked for the same
+    lane-padding reason as the histogram."""
+    nbk = B.shape[0] // blk
+
+    def route_block(asg, i):
+        Bblk = jax.lax.dynamic_slice_in_dim(B, i * blk, blk)
+        relblk = jax.lax.dynamic_slice_in_dim(rel, i * blk, blk)
+        ablk = jax.lax.dynamic_slice_in_dim(active, i * blk, blk)
+        asgblk = jax.lax.dynamic_slice_in_dim(asg, i * blk, blk)
+        rf = _sel_table(best_f, relblk)
+        rt = _sel_table(best_t, relblk)
+        rs = _sel_table(split, relblk) & ablk
+        gr = _sel_col(Bblk, rf) > rt
+        new = jnp.where(rs, 2 * asgblk + 1 + gr.astype(jnp.int32),
+                        asgblk)
+        return jax.lax.dynamic_update_slice_in_dim(
+            asg, new, i * blk, axis=0), None
+
+    asg, _ = jax.lax.scan(route_block, assign, jnp.arange(nbk))
+    return asg
+
+
+def _leaf_stats_xla(assign, stats_T, *, n_nodes, blk):
+    """Local per-leaf sufficient statistics (oracle path) — the same
+    matmul-histogram trick over the final assignment. (S, M)."""
+    S = stats_T.shape[0]
+    nbk = assign.shape[0] // blk
+
+    def leaf_block(acc, i):
+        asgblk = jax.lax.dynamic_slice_in_dim(assign, i * blk, blk)
+        sblk = jax.lax.dynamic_slice_in_dim(stats_T, i * blk, blk, axis=1)
+        hdt = _hist_dtype()
+        oh = (asgblk[:, None] == jnp.arange(n_nodes)[None, :]).astype(hdt)
+        return acc + jax.lax.dot(sblk.astype(hdt), oh,
+                                 preferred_element_type=jnp.float32), None
+
+    leaf, _ = jax.lax.scan(
+        leaf_block, jnp.zeros((S, n_nodes), jnp.float32), jnp.arange(nbk))
+    return leaf
+
+
 def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
-                gain_fn, weight_fn, min_child_weight, min_gain):
+                gain_fn, weight_fn, min_child_weight, min_gain,
+                use_kernel=False):
     """Grow one tree. All shapes static; call inside shard_map.
 
     B: (n, d) uint8 bin codes (local shard rows).
@@ -190,6 +313,11 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         (random-forest per-tree feature subsampling).
     gain_fn(left, total) -> gain over trailing stat dim; higher is better.
     weight_fn(stat_sums) -> scalar node weight for min_child_weight.
+    use_kernel: route the histogram/routing/leaf passes through the
+        fused Pallas kernels (ops/pallas_kernels.py) instead of the
+        blocked XLA contraction oracle. Must be static (it selects the
+        compiled program); split decisions and per-level psums are
+        identical either way.
 
     Returns (feat (M,), thr (M,), is_internal (M,), leaf_stats (M, S)) with
     M = 2^(max_depth+1) - 1 nodes; children of i at 2i+1 / 2i+2.
@@ -197,17 +325,19 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
     n, d = B.shape
     S = stats_T.shape[0]
     M = 2 ** (max_depth + 1) - 1
-    blk, nbk, n_pad = _block_shape(n, d * n_bins)
+    if use_kernel:
+        # Kernel row tiles are VMEM-sized; everything else about the
+        # level loop (and the per-level psum) is shared with the oracle.
+        blk = pallas_kernels.tree_tile(d, n_bins)
+        nbk = -(-n // blk)
+        n_pad = nbk * blk
+    else:
+        blk, nbk, n_pad = _block_shape(n, d * n_bins)
     if n_pad != n:
         B = jnp.pad(B, ((0, n_pad - n), (0, 0)))
         stats_T = jnp.pad(stats_T, ((0, 0), (0, n_pad - n)))
-    # Blocks are carved with dynamic_slice inside each scan body (index
-    # scan) rather than scanning over a stacked (nbk, blk, ...) operand:
-    # XLA:TPU compiles scans over multi-hundred-MB stacked inputs ~30x
-    # slower (measured 23.5s vs 0.8s for a trivial body at 11 x 1M rows),
-    # which put whole-family compiles in the minutes.
+    hdt = _hist_dtype()
 
-    bins_u8 = jnp.arange(n_bins, dtype=jnp.uint8)[None, None, :]
     #: Fixed per-level node width: the deepest processed level has
     #: 2^(max_depth-1) nodes, and every level runs at that width so the
     #: whole level loop is ONE lax.scan body (a per-level Python unroll
@@ -227,41 +357,14 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         active = (rel >= 0) & (rel < nl)
         rel = jnp.where(active, rel, 0)
 
-        # (node, feature, bin, stat) histogram as ONE MXU contraction per
-        # block — not scatters (TPU scatter-adds serialize) and not a
-        # per-feature matmul loop (n_bins=32 lane-pads to 128, NL·S is
-        # sublane-starved, and the d-way unroll bloats compile time). The
-        # (feature, bin) one-hot packs into a single (blk, d·n_bins)
-        # operand so every feature rides the same matmul: A packs
-        # node-masked per-row stats (NL·S, blk); one
-        # (NL·S, blk) @ (blk, d·n_bins) product per block.
-        def hist_block(hist, i):
-            Bblk = jax.lax.dynamic_slice_in_dim(B, i * blk, blk)
-            relblk = jax.lax.dynamic_slice_in_dim(rel, i * blk, blk)
-            ablk = jax.lax.dynamic_slice_in_dim(active, i * blk, blk)
-            sblk = jax.lax.dynamic_slice_in_dim(
-                stats_T, i * blk, blk, axis=1)               # (S, blk)
-            node_oh = ((relblk[:, None] == jnp.arange(NL)[None, :])
-                       & ablk[:, None])                      # (blk, NL)
-            # bf16 operands (on TPU) halve the dominant HBM traffic (the
-            # (blk, d·n_bins) one-hot materialization); products of {0,1}
-            # one-hots with bf16-rounded stats are exact, and partial
-            # sums accumulate in f32 via preferred_element_type.
-            hdt = _hist_dtype()
-            A = (node_oh[:, :, None].astype(hdt)
-                 * sblk.T.astype(hdt)[:, None, :])           # (blk, NL, S)
-            At = A.reshape(blk, NL * S).T                    # (NL·S, blk)
-            oh = (Bblk[:, :, None] == bins_u8).astype(hdt)
-            return hist + jax.lax.dot(
-                At, oh.reshape(blk, d * n_bins),
-                preferred_element_type=jnp.float32), None
-
-        hist, _ = jax.lax.scan(
-            hist_block, jnp.zeros((NL * S, d * n_bins), jnp.float32),
-            jnp.arange(nbk))
-        hist = jax.lax.psum(hist, DATA_AXIS)                     # ICI reduce
-        # (NL·S, d·nb) → (NL, d, bins, S)
-        hist = hist.reshape(NL, S, d, n_bins).transpose(0, 2, 3, 1)
+        if use_kernel:
+            hist = pallas_kernels.tree_histogram(
+                B, stats_T, rel, active, n_nodes=NL, n_bins=n_bins,
+                tile=blk, operand_dtype=hdt)
+        else:
+            hist = _hist_level_xla(B, stats_T, rel, active, n_nodes=NL,
+                                   n_bins=n_bins, blk=blk)
+        hist = jax.lax.psum(hist, DATA_AXIS)                 # ICI reduce
 
         left = jnp.cumsum(hist, axis=2)                          # ≤ bin t
         total = left[:, :, -1:, :]                               # (NL,d,1,S)
@@ -285,23 +388,12 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         thr = thr.at[node_ids].set(jnp.where(split, best_t, 0))
         is_internal = is_internal.at[node_ids].set(split)
 
-        # Route rows of split nodes to children; leaf rows keep their
-        # node. Blocked for the same lane-padding reason.
-        def route_block(asg, i):
-            Bblk = jax.lax.dynamic_slice_in_dim(B, i * blk, blk)
-            relblk = jax.lax.dynamic_slice_in_dim(rel, i * blk, blk)
-            ablk = jax.lax.dynamic_slice_in_dim(active, i * blk, blk)
-            asgblk = jax.lax.dynamic_slice_in_dim(asg, i * blk, blk)
-            rf = _sel_table(best_f, relblk)
-            rt = _sel_table(best_t, relblk)
-            rs = _sel_table(split, relblk) & ablk
-            gr = _sel_col(Bblk, rf) > rt
-            new = jnp.where(rs, 2 * asgblk + 1 + gr.astype(jnp.int32),
-                            asgblk)
-            return jax.lax.dynamic_update_slice_in_dim(
-                asg, new, i * blk, axis=0), None
-
-        asg, _ = jax.lax.scan(route_block, assign, jnp.arange(nbk))
+        if use_kernel:
+            asg = pallas_kernels.tree_route_level(
+                B, rel, active, assign, best_f, best_t, split, tile=blk)
+        else:
+            asg = _route_level_xla(B, rel, active, assign, best_f,
+                                   best_t, split, blk=blk)
         return (feat, thr, is_internal, asg), None
 
     (feat, thr, is_internal, assign), _ = jax.lax.scan(
@@ -311,24 +403,28 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         jnp.arange(max_depth))
 
     # Leaf sufficient statistics over ALL nodes (every row sits at a leaf;
-    # padded columns carry zero stats) — the same matmul-histogram trick.
-    def leaf_block(acc, i):
-        asgblk = jax.lax.dynamic_slice_in_dim(assign, i * blk, blk)
-        sblk = jax.lax.dynamic_slice_in_dim(stats_T, i * blk, blk, axis=1)
-        hdt = _hist_dtype()
-        oh = (asgblk[:, None] == jnp.arange(M)[None, :]).astype(hdt)
-        return acc + jax.lax.dot(sblk.astype(hdt), oh,
-                                 preferred_element_type=jnp.float32), None
-
-    leaf, _ = jax.lax.scan(
-        leaf_block, jnp.zeros((S, M), jnp.float32), jnp.arange(nbk))
+    # padded columns carry zero stats).
+    if use_kernel:
+        leaf = pallas_kernels.tree_leaf_stats(
+            assign, stats_T, n_nodes=M, tile=blk, operand_dtype=hdt)
+    else:
+        leaf = _leaf_stats_xla(assign, stats_T, n_nodes=M, blk=blk)
     leaf = jax.lax.psum(leaf.T, DATA_AXIS)                   # (M, S)
     return feat, thr, is_internal, leaf
 
 
-def _descend(B, feat, thr, is_internal, max_depth):
-    """Blocked routing of binned rows to their leaf node id."""
+def _descend(B, feat, thr, is_internal, max_depth, use_kernel=False):
+    """Blocked routing of binned rows to their leaf node id.
+
+    ``use_kernel`` routes through the fused Pallas descent kernel; the
+    result is bit-identical either way (integer arithmetic throughout),
+    so predict paths may flip it per batch shape — batches below the
+    kernel row tile (e.g. the serving tier's row-wise AOT programs) stay
+    on the oracle, where tile padding would dominate."""
     n, d = B.shape
+    if use_kernel and n >= pallas_kernels.TREE_ROUTE_TILE:
+        return pallas_kernels.tree_descend(B, feat, thr, is_internal,
+                                           max_depth=max_depth)
     blk, nbk, n_pad = _block_shape(n)
     if n_pad != n:
         B = jnp.pad(B, ((0, n_pad - n), (0, 0)))
@@ -391,9 +487,10 @@ def _make_newton_gain(lam: float):
 
 @partial(jax.jit,
          static_argnames=("num_classes", "max_depth", "n_bins", "n_trees",
-                          "mesh", "mtry"))
+                          "mesh", "mtry", "use_kernel"))
 def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
-                n_trees, mesh, mtry, min_child_weight=1.0):
+                n_trees, mesh, mtry, min_child_weight=1.0,
+                use_kernel=False):
     """dt (n_trees=1, no bagging) and rf (bootstrap + feature subsampling)."""
     d = B.shape[1]
 
@@ -423,7 +520,8 @@ def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
             feat, thr, internal, leaf = _build_tree(
                 B, stats, fmask, max_depth=max_depth, n_bins=n_bins,
                 gain_fn=_gini_gain, weight_fn=lambda s: s.sum(-1),
-                min_child_weight=min_child_weight, min_gain=1e-9)
+                min_child_weight=min_child_weight, min_gain=1e-9,
+                use_kernel=use_kernel)
             return feat, thr, internal, leaf
 
         # Trees build in vmapped batches: a batch's (NL·S, blk) histogram
@@ -464,8 +562,7 @@ def _edge_prep(X, n_bins: int = 32, **_ignored) -> dict:
     sample comes from strided range reads (quantile sketches over samples
     are the norm for histogram GBTs — the full-matrix path itself
     subsamples to 200k)."""
-    if n_bins > 256:
-        raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
+    validate_n_bins(n_bins)
     X = as_design(X)
     return {"edges": quantile_edges(
         X if isinstance(X, np.ndarray) else X.sample_rows(200_000), n_bins)}
@@ -473,8 +570,7 @@ def _edge_prep(X, n_bins: int = 32, **_ignored) -> dict:
 
 def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
                    max_depth, n_bins, mtry=None, edges=None):
-    if n_bins > 256:
-        raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
+    validate_n_bins(n_bins)
 
     X = as_design(X)
     if edges is None:
@@ -494,7 +590,8 @@ def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
     feat, thr, internal, leaf = _fit_forest(
         B_dev, y_dev, valid_dev, jax.random.PRNGKey(seed),
         num_classes=num_classes, max_depth=max_depth, n_bins=n_bins,
-        n_trees=n_trees, mesh=runtime.mesh, mtry=mtry)
+        n_trees=n_trees, mesh=runtime.mesh, mtry=mtry,
+        use_kernel=_use_tree_kernel(runtime))
     params = {"edges": jnp.asarray(edges), "feat": feat, "thr": thr,
               "internal": internal, "leaf": leaf}
     return TrainedModel(
@@ -508,9 +605,14 @@ def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
 @partial(jax.jit, static_argnames=("max_depth",))
 def _forest_proba_static(params, X, *, max_depth):
     B = bin_features(X, params["edges"])
+    # Trace-time kernel selection is safe here: descent is integer
+    # arithmetic, so probabilities are bit-identical on either path (the
+    # AOT row-wise predict programs stay on the oracle via the batch-size
+    # gate in _descend).
+    use_kernel = _use_tree_kernel()
 
     def tree_proba(f, t, it, lf):
-        assign = _descend(B, f, t, it, max_depth)
+        assign = _descend(B, f, t, it, max_depth, use_kernel=use_kernel)
         counts = _sel_rows_blocked(lf, assign)
         return counts / jnp.maximum(counts.sum(-1, keepdims=True), 1e-12)
 
@@ -545,9 +647,10 @@ fit_rf.host_prep = _edge_prep
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit,
-         static_argnames=("max_depth", "n_bins", "n_rounds", "mesh"))
+         static_argnames=("max_depth", "n_bins", "n_rounds", "mesh",
+                          "use_kernel"))
 def _fit_gbt(B, y, valid, *, max_depth, n_bins, n_rounds, mesh,
-             step_size=0.1, lam=1.0):
+             step_size=0.1, lam=1.0, use_kernel=False):
     M = 2 ** (max_depth + 1) - 1
 
     def shard_fn(B, y, valid):
@@ -564,9 +667,11 @@ def _fit_gbt(B, y, valid, *, max_depth, n_bins, n_rounds, mesh,
                 B, stats, jnp.zeros((B.shape[1],), jnp.float32),
                 max_depth=max_depth, n_bins=n_bins, gain_fn=gain_fn,
                 weight_fn=lambda s: s[..., 1],
-                min_child_weight=1e-3, min_gain=1e-9)
+                min_child_weight=1e-3, min_gain=1e-9,
+                use_kernel=use_kernel)
             leaf_val = -leaf[:, 0] / (leaf[:, 1] + lam)       # (M,)
-            assign = _descend(B, feat, thr, internal, max_depth)
+            assign = _descend(B, feat, thr, internal, max_depth,
+                              use_kernel=use_kernel)
             margin = margin + step_size * _sel_table_blocked(leaf_val,
                                                              assign)
             return margin, (feat, thr, internal, leaf_val)
@@ -584,9 +689,11 @@ def _fit_gbt(B, y, valid, *, max_depth, n_bins, n_rounds, mesh,
 @partial(jax.jit, static_argnames=("max_depth",))
 def _gbt_proba_static(params, X, *, max_depth):
     B = bin_features(X, params["edges"])
+    use_kernel = _use_tree_kernel()
 
     def tree_margin(f, t, it, lv):
-        return _sel_table_blocked(lv, _descend(B, f, t, it, max_depth))
+        return _sel_table_blocked(lv, _descend(B, f, t, it, max_depth,
+                                               use_kernel=use_kernel))
 
     margins = jax.vmap(tree_margin)(params["feat"], params["thr"],
                                     params["internal"], params["leaf_val"])
@@ -601,10 +708,12 @@ def _gbt_ovr_proba_static(params, X, *, max_depth):
     class axis on every tree param), class scores p_k = σ(margin_k),
     normalized — standard one-vs-rest calibration."""
     B = bin_features(X, params["edges"])
+    use_kernel = _use_tree_kernel()
 
     def class_margin(feat, thr, internal, leaf_val):
         def tree_margin(f, t, it, lv):
-            return _sel_table_blocked(lv, _descend(B, f, t, it, max_depth))
+            return _sel_table_blocked(lv, _descend(B, f, t, it, max_depth,
+                                                   use_kernel=use_kernel))
 
         return jax.vmap(tree_margin)(feat, thr, internal,
                                      leaf_val).sum(axis=0)
@@ -628,8 +737,7 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
     (``_gbt_ovr_proba_static``). Each booster's margin is bit-identical
     to a standalone binary fit on the same rest-labeled split (parity
     pinned in tests/test_models.py)."""
-    if n_bins > 256:
-        raise ValueError("n_bins is capped at 256 (uint8 bin codes)")
+    validate_n_bins(n_bins)
 
     X = as_design(X)
     if edges is None:
@@ -643,12 +751,13 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
         (np.arange(padded_len) < n).astype(np.float32))
     hparams = {"n_rounds": n_rounds, "max_depth": max_depth,
                "n_bins": n_bins, "step_size": step_size}
+    use_kernel = _use_tree_kernel(runtime)
     if num_classes == 2:
         y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
         feat, thr, internal, leaf_val = _fit_gbt(
             B_dev, y_dev, valid_dev, max_depth=max_depth, n_bins=n_bins,
             n_rounds=n_rounds, mesh=runtime.mesh,
-            step_size=step_size)
+            step_size=step_size, use_kernel=use_kernel)
         params = {"edges": jnp.asarray(edges), "feat": feat, "thr": thr,
                   "internal": internal, "leaf_val": leaf_val,
                   "step_size": jnp.float32(step_size)}
@@ -665,9 +774,8 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
         yk_dev, _ = runtime.shard_rows((y_np == k).astype(np.int32))
         per_class.append(_fit_gbt(
             B_dev, yk_dev, valid_dev, max_depth=max_depth, n_bins=n_bins,
-            n_rounds=n_rounds, mesh=runtime.mesh, step_size=step_size))
-        from learningorchestra_tpu.parallel import spmd
-
+            n_rounds=n_rounds, mesh=runtime.mesh, step_size=step_size,
+            use_kernel=use_kernel))
         # Boosters enqueue back-to-back; fence the multi-process CPU rig
         # (no-op on TPU — stream order already aligns the collectives).
         spmd.serialize_collectives(per_class[-1])
