@@ -1162,7 +1162,10 @@ class Dataset:
 def _fsync_file(path: str) -> None:
     fd = os.open(path, os.O_RDONLY)
     try:
-        os.fsync(fd)
+        # Durability helper, not a commit point: the two-phase commits
+        # that CALL it carry the failpoint sites (write_chunk.pre_rename,
+        # journal.pre_swap), so the crash sweep already brackets this.
+        os.fsync(fd)  # lolint: disable=failpoint-coverage
     finally:
         os.close(fd)
 
@@ -1175,7 +1178,9 @@ def _fsync_dir(path: str) -> None:
     except OSError:
         return
     try:
-        os.fsync(fd)
+        # Same as _fsync_file: durability plumbing for commit points
+        # that carry their own failpoint sites at the rename itself.
+        os.fsync(fd)  # lolint: disable=failpoint-coverage
     except OSError:
         pass
     finally:

@@ -164,6 +164,18 @@ class SourceChanged(ValueError):
     parsed from; resuming would corrupt the dataset."""
 
 
+def _close_after(resp, it: Iterator[bytes]) -> Iterator[bytes]:
+    """Stream ``it`` and close ``resp`` on exhaustion, error, or
+    abandonment: a midstream ChunkedEncodingError (or a consumer that
+    stops early) would otherwise drop the response with a half-read
+    socket, which surfaces at GC time as an unraisable — and the test
+    suite runs with warnings-as-errors."""
+    try:
+        yield from it
+    finally:
+        resp.close()
+
+
 def _open_url_stream(url: str, timeout: float,
                      offset: int = 0) -> Iterator[bytes]:
     """Yield byte chunks from a URL (http(s)://) or local file (file:// or
@@ -187,6 +199,7 @@ def _open_url_stream(url: str, timeout: float,
             # the 416's Content-Range total before concluding the source
             # shrank.
             total = _content_range_total(resp.headers.get("Content-Range"))
+            resp.close()   # verdict is in the headers; drop the body
             if total is not None and total == offset:
                 return iter(())             # every byte already committed
             if total is None:
@@ -194,18 +207,26 @@ def _open_url_stream(url: str, timeout: float,
                 resp = _http_session().get(
                     url, stream=True, timeout=timeout,
                     headers={"Accept-Encoding": "identity"})
-                resp.raise_for_status()
-                return _skip_bytes(
-                    resp.iter_content(chunk_size=_CHUNK_BYTES), offset)
+                try:
+                    resp.raise_for_status()
+                except Exception:
+                    resp.close()
+                    raise
+                return _close_after(resp, _skip_bytes(
+                    resp.iter_content(chunk_size=_CHUNK_BYTES), offset))
             raise SourceChanged(
                 f"source at {url} is {total} bytes, shorter than the "
                 f"committed resume offset {offset}; it must have changed "
                 "since the interrupted ingest")
-        resp.raise_for_status()
+        try:
+            resp.raise_for_status()
+        except Exception:
+            resp.close()
+            raise
         it = resp.iter_content(chunk_size=_CHUNK_BYTES)
         if offset and resp.status_code != 206:
             it = _skip_bytes(it, offset)
-        return it
+        return _close_after(resp, it)
     path = url[len("file://"):] if url.startswith("file://") else url
 
     def file_chunks() -> Iterator[bytes]:
@@ -373,6 +394,10 @@ def _run_ingest(store: DatasetStore, name: str, url: str, cfg,
         except Exception as exc:  # noqa: BLE001 — forwarded to consumer
             _put(exc)
 
+    # thread-lifecycle: owner=_run_ingest; exits when the stream is
+    # drained, the consumer stops (_put returns False after close), or
+    # on error — every exception is forwarded through the queue to the
+    # consumer (the except below), never left to die uncaught; daemon.
     t = threading.Thread(target=downloader, daemon=True, name="lo-ingest-dl")
     t.start()
 

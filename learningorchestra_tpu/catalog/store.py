@@ -42,6 +42,7 @@ from learningorchestra_tpu.utils import failpoints
 #: Deterministic fault-injection sites (utils/failpoints.py).
 FP_MIRROR_PRE_COPY = failpoints.declare("store.mirror.pre_copy")
 FP_FINISH_PRE_SAVE = failpoints.declare("store.finish.pre_save")
+FP_SAVE_PRE_META_SWAP = failpoints.declare("store.save.pre_meta_swap")
 
 
 class DatasetNotFound(KeyError):
@@ -543,6 +544,10 @@ class DatasetStore:
         tmp = os.path.join(path, "metadata.json.tmp")
         with open(tmp, "w") as f:
             json.dump(ds.metadata.to_doc(), f, default=str)
+        # Crash window between journal commit (above) and the metadata
+        # swap: load() rebuilds metadata.fields from journal dtypes, so
+        # the sweep proves a stale/missing metadata.json is recoverable.
+        failpoints.fire(FP_SAVE_PRE_META_SWAP)
         os.replace(tmp, os.path.join(path, "metadata.json"))
         ds.maybe_evict()
         if self.cfg.replica_root:

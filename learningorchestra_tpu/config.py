@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field, fields
+from typing import Optional
 
 
 def _env(name: str, default, cast=None):
@@ -284,3 +285,100 @@ class Settings:
 
 #: Process-global settings instance. Tests construct their own.
 settings = Settings()
+
+
+# --- dynamic environment accessors ------------------------------------------
+# Knobs that cannot be Settings fields because they change within a
+# process's lifetime (the supervisor bumps LO_TPU_MESH_EPOCH and
+# LO_TPU_RESTART_COUNT per pod restart, and the poison/health scope must
+# follow the env, not an import-time snapshot) or are read before any
+# Settings instance exists (failpoint arming at import). They still live
+# HERE: every LO_TPU_* read in the codebase is either a Settings field
+# above or an accessor below, so one file answers "what knobs exist" —
+# enforced by lolint's env-discipline rule (docs/static_analysis.md),
+# which also cross-checks that each knob named in this file appears in
+# docs/configuration.md.
+
+
+def restart_count() -> int:
+    """This incarnation's supervisor restart ordinal
+    (``LO_TPU_RESTART_COUNT``, set by supervisor.py for each supervised
+    child; 0 = first launch). Served on ``/cluster`` as ``restarts``."""
+    try:
+        return int(os.environ.get("LO_TPU_RESTART_COUNT", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def mesh_epoch() -> int:
+    """The pod's mesh generation (``LO_TPU_MESH_EPOCH``) — bumped by the
+    supervisor on every restart so the SPMD job channel can reject
+    workers from a previous incarnation (parallel/spmd.py). Read per
+    call, never cached: the epoch-scoped pod poison follows the env."""
+    try:
+        return int(os.environ.get("LO_TPU_MESH_EPOCH", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def coordinator_address(default: Optional[str] = None) -> Optional[str]:
+    """``host:port`` of process 0's jax.distributed coordination service
+    (``LO_TPU_COORDINATOR``); also locates the SPMD job channel
+    (coordinator host, port + 1). None/default = single-host."""
+    return os.environ.get("LO_TPU_COORDINATOR") or default
+
+
+def job_port(default: int) -> int:
+    """Explicit SPMD job-channel port (``LO_TPU_JOB_PORT``); defaults to
+    the coordinator port + 1 computed by the caller. A malformed value
+    raises immediately: silently falling back would have coordinator and
+    workers listening on different ports, surfacing as an opaque
+    handshake timeout instead of a config error."""
+    raw = os.environ.get("LO_TPU_JOB_PORT")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"LO_TPU_JOB_PORT must be an integer, got {raw!r}") from None
+
+
+def num_processes() -> Optional[int]:
+    """Pod process count for jax.distributed init
+    (``LO_TPU_NUM_PROCESSES``); None = unset (single-host)."""
+    raw = os.environ.get("LO_TPU_NUM_PROCESSES")
+    return int(raw) if raw else None
+
+
+def process_id() -> Optional[int]:
+    """This process's pod rank for jax.distributed init
+    (``LO_TPU_PROCESS_ID``); None = unset (single-host)."""
+    raw = os.environ.get("LO_TPU_PROCESS_ID")
+    return int(raw) if raw is not None and raw != "" else None
+
+
+def peak_flops() -> float:
+    """Override for the per-chip peak dense-matmul FLOP/s used as the
+    MFU denominator (``LO_TPU_PEAK_FLOPS``; models/flops.py defaults to
+    the v5e bf16 figure). 0.0 = unset."""
+    try:
+        return float(os.environ.get("LO_TPU_PEAK_FLOPS", "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def peak_bw() -> float:
+    """Override for the per-chip peak HBM bandwidth used as the
+    ``bw_util`` denominator (``LO_TPU_PEAK_BW``). 0.0 = unset."""
+    try:
+        return float(os.environ.get("LO_TPU_PEAK_BW", "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def failpoint_spec() -> str:
+    """The deterministic fault-injection arming spec
+    (``LO_TPU_FAILPOINTS=site=mode[:nth],...``), read at
+    utils/failpoints.py import — before any Settings exists."""
+    return os.environ.get("LO_TPU_FAILPOINTS", "")

@@ -41,31 +41,25 @@ landed (LO_TPU_TREE_KERNEL, models/trees.py):
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional
 
-
-def _env_f(name: str) -> float:
-    try:
-        return float(os.environ.get(name, "") or 0.0)
-    except ValueError:
-        return 0.0
-
+from learningorchestra_tpu import config
 
 #: Peak dense-matmul FLOP/s of one TPU v5e chip at bf16 (the dtype the
 #: dominant contractions here actually use: trees' histogram matmuls and
 #: lr's Newton accumulation run bf16 operands with f32 accumulation).
-#: Override with LO_TPU_PEAK_FLOPS for other parts/backends.
+#: Override with LO_TPU_PEAK_FLOPS (config.peak_flops) for other
+#: parts/backends.
 V5E_PEAK_BF16 = 197e12
 
-PEAK_FLOPS = _env_f("LO_TPU_PEAK_FLOPS") or V5E_PEAK_BF16
+PEAK_FLOPS = config.peak_flops() or V5E_PEAK_BF16
 
 #: Peak HBM bandwidth of one TPU v5e chip (819 GB/s) — the denominator
 #: of ``bw_util`` for memory-bound programs (kernel-path tree fits).
-#: Override with LO_TPU_PEAK_BW.
+#: Override with LO_TPU_PEAK_BW (config.peak_bw).
 V5E_HBM_BW = 819e9
 
-PEAK_BW = _env_f("LO_TPU_PEAK_BW") or V5E_HBM_BW
+PEAK_BW = config.peak_bw() or V5E_HBM_BW
 
 
 def _tree_kernel_default() -> bool:

@@ -23,6 +23,8 @@ from typing import Optional
 
 import jax
 
+from learningorchestra_tpu import config
+
 _initialized = False
 
 
@@ -45,12 +47,11 @@ def initialize(coordinator_address: Optional[str] = None,
     global _initialized
     if _initialized:
         return
-    coordinator_address = coordinator_address or os.environ.get(
-        "LO_TPU_COORDINATOR")
-    if num_processes is None and "LO_TPU_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["LO_TPU_NUM_PROCESSES"])
-    if process_id is None and "LO_TPU_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["LO_TPU_PROCESS_ID"])
+    coordinator_address = coordinator_address or config.coordinator_address()
+    if num_processes is None:
+        num_processes = config.num_processes()
+    if process_id is None:
+        process_id = config.process_id()
     if coordinator_address is None and num_processes is None:
         return  # single-host
     if "cpu" in (os.environ.get("JAX_PLATFORMS") or ""):

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from learningorchestra_tpu import config as _config
 from learningorchestra_tpu.utils import failpoints
 
 log = logging.getLogger("lo_tpu.spmd")
@@ -90,18 +91,14 @@ def mesh_epoch() -> int:
     differs at handshake, so a stale worker from a previous incarnation
     can never join the new pod's collectives. Read dynamically (not
     cached) so the poison scope below follows the env."""
-    try:
-        return int(os.environ.get("LO_TPU_MESH_EPOCH", "0") or 0)
-    except ValueError:
-        return 0
+    return _config.mesh_epoch()
 
 
 def _job_addr() -> tuple:
     """(host, port) of the job channel — coordinator host, port + 1."""
-    coord = os.environ.get("LO_TPU_COORDINATOR", "127.0.0.1:8476")
+    coord = _config.coordinator_address("127.0.0.1:8476")
     host, _, port = coord.rpartition(":")
-    job_port = int(os.environ.get("LO_TPU_JOB_PORT", int(port) + 1))
-    return host or "127.0.0.1", job_port
+    return host or "127.0.0.1", _config.job_port(int(port) + 1)
 
 
 def _close_quietly(sock: socket.socket) -> None:
@@ -169,6 +166,9 @@ class _JobChannel:
         self._conns: List[_Conn] = []
         _, port = _job_addr()
         self._srv = socket.create_server(("", port))
+        # thread-lifecycle: owner=_JobChannel; exits when close() closes
+        # the server socket (accept raises OSError → return); daemon for
+        # process teardown.
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="lo-spmd-accept")
         t.start()
@@ -182,6 +182,9 @@ class _JobChannel:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # Handshake off-thread: a half-open connection that never
             # sends its hello must not block later workers from joining.
+            # thread-lifecycle: owner=_JobChannel; exits after one
+            # recv_line (30s timeout) — rejects or registers the worker
+            # and returns; daemon.
             threading.Thread(target=self._handshake, args=(sock,),
                              daemon=True, name="lo-spmd-handshake").start()
 
@@ -500,6 +503,9 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
                 except Exception:  # noqa: BLE001 — best-effort flagging
                     log.exception("could not fail output %s", name)
 
+        # thread-lifecycle: owner=dispatch_job; exits when the finally
+        # below sets the stop event and joins it (2s timeout); on_death
+        # failures are logged, never raised off-thread.
         monitor = threading.Thread(
             target=_get_channel().monitor_workers, args=(stop, on_death),
             daemon=True, name="lo-spmd-watchdog")

@@ -19,13 +19,13 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 from typing import Optional
 
 from learningorchestra_tpu.catalog.dataset import ChunkCorrupt
 from learningorchestra_tpu.catalog.ingest import ingest_csv_url
 from learningorchestra_tpu.catalog.store import (
     DatasetExists, DatasetNotFound, DatasetStore)
+from learningorchestra_tpu import config
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.jobs import JobManager, select_retry_groups
 from learningorchestra_tpu.models.builder import ModelBuilder
@@ -383,8 +383,7 @@ class App:
             info["mesh_epoch"] = spmd.mesh_epoch()
             info["pod_error"] = spmd.pod_error()
             info["healthy"] = info["pod_error"] is None
-            info["restarts"] = int(
-                os.environ.get("LO_TPU_RESTART_COUNT", "0") or 0)
+            info["restarts"] = config.restart_count()
             return 200, info
 
         @self._route("GET", "/jobs")

@@ -144,6 +144,11 @@ class ModelBatcher:
         self._queue: collections.deque = collections.deque()
         self._queue_rows = 0
         self._stopped = False
+        # thread-lifecycle: owner=ModelBatcher; exits when stop() sets
+        # _stopped under the cond (joined there, 5s timeout); _loop's
+        # per-group try/except scatters dispatch errors to requests, and
+        # an escape above it is caught by the test harness's
+        # threading.excepthook sanitizer (the PR 6 silent-death class).
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"lo-predict-{name}")
         self._thread.start()

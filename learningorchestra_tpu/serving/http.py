@@ -290,6 +290,9 @@ class Server:
         self._stop_callbacks.append(fn)
 
     def start_background(self) -> "Server":
+        # thread-lifecycle: owner=Server; exits when stop() calls
+        # httpd.shutdown() (serve_forever returns); daemon so a test
+        # that never stops cannot hang interpreter exit.
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="lo-http")
         self._thread.start()

@@ -134,7 +134,12 @@ def reset() -> None:
 
 
 def _load_env() -> None:
-    spec = os.environ.get(ENV_VAR, "")
+    # Local import: config is the single home of LO_TPU_* reads
+    # (lolint env-discipline), and importing it lazily keeps this
+    # module free of package imports at its own import time.
+    from learningorchestra_tpu.config import failpoint_spec
+
+    spec = failpoint_spec()
     if spec:
         configure(spec)
 

@@ -1,0 +1,21 @@
+"""FIRING fixture for env-discipline: LO_TPU_* read outside config.py."""
+
+import os
+
+_QUEUE_KEY = "LO_TPU_SERVE_QUEUE_DEPTH"
+
+
+def queue_depth():
+    return int(os.environ.get(_QUEUE_KEY, "0"))     # via a constant
+
+
+def mesh_epoch():
+    return int(os.environ["LO_TPU_MESH_EPOCH"])     # subscript read
+
+
+def profile_dir():
+    return os.getenv("LO_TPU_PROFILE_DIR")          # os.getenv form
+
+
+def profiling_enabled():
+    return "LO_TPU_PROFILE_DIR" in os.environ       # membership probe

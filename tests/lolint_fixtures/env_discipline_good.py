@@ -1,0 +1,19 @@
+"""NON-FIRING fixture for env-discipline: knobs come from config.py."""
+
+import os
+
+from learningorchestra_tpu import config
+from learningorchestra_tpu.config import settings
+
+
+def queue_depth():
+    return settings.serve_queue_depth       # typed Settings field
+
+
+def mesh_epoch():
+    return config.mesh_epoch()              # dynamic accessor
+
+
+def platform():
+    # Non-LO_TPU_ env vars are out of scope for the rule.
+    return os.environ.get("JAX_PLATFORMS", "")

@@ -1,0 +1,22 @@
+"""FIRING fixture for failpoint-coverage: commit points the crash
+sweep cannot reach."""
+
+import os
+
+from learningorchestra_tpu.utils import failpoints
+
+FP_UNDECLARED = "test.fixture.not_via_declare"   # plain string, no declare()
+
+
+def commit(tmp, dst):
+    os.rename(tmp, dst)                 # two-phase commit, no fire() site
+
+
+def commit_literal(tmp, dst):
+    failpoints.fire("test.fixture.adhoc")   # literal: never registered
+    os.rename(tmp, dst)
+
+
+def commit_undeclared(tmp, dst):
+    failpoints.fire(FP_UNDECLARED)      # constant not from declare()
+    os.replace(tmp, dst)

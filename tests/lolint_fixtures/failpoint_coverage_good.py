@@ -1,0 +1,19 @@
+"""NON-FIRING fixture for failpoint-coverage: every commit point has a
+declared, registered site."""
+
+import os
+
+from learningorchestra_tpu.utils import failpoints
+
+FP_PRE_RENAME = failpoints.declare("test.fixture.pre_rename")
+
+
+def commit(tmp, dst, dirfd):
+    failpoints.fire(FP_PRE_RENAME)
+    os.rename(tmp, dst)
+    os.fsync(dirfd)                     # same function ⇒ covered
+
+
+def read_side(path):
+    with open(path) as f:               # no commit point here at all
+        return f.read()

@@ -1,0 +1,27 @@
+"""FIRING fixture for handler-error-map: swallowed/unmapped errors.
+
+``QueueFull`` is defined but no except clause anywhere in (pretend)
+serving/ maps it — the finalize pass flags that as a raw-500 path.
+"""
+
+
+class QueueFull(Exception):
+    """Raised when the per-model queue is at depth."""
+
+
+def _do(req):
+    return req
+
+
+def handle(req):
+    try:
+        return 200, _do(req)
+    except:  # noqa: E722 — the point of the fixture
+        return 200, None
+
+
+def poll(q):
+    try:
+        q.get_nowait()
+    except Exception:
+        pass

@@ -1,0 +1,30 @@
+"""NON-FIRING fixture for handler-error-map: every serving-defined
+exception class is mapped to a status code somewhere in serving/."""
+
+import logging
+
+log = logging.getLogger("fx")
+
+
+class RateLimited(Exception):
+    """Client must back off."""
+
+
+def _do(req):
+    return req
+
+
+def handle(req):
+    try:
+        return 200, _do(req)
+    except RateLimited:
+        return 429, {"error": "slow down"}
+    except (ValueError, TypeError) as e:
+        return 406, {"error": str(e)}
+
+
+def poll(q):
+    try:
+        q.get_nowait()
+    except Exception:
+        log.exception("poll failed")     # logged, not black-holed

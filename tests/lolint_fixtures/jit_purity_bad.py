@@ -1,0 +1,30 @@
+"""FIRING fixture for jit-purity: host effects inside traced code.
+
+Never imported — parsed by tests/test_lolint.py under a pretend
+package path (see CASES there).
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+_calls = 0
+
+
+@jax.jit
+def step(x):
+    global _calls          # global mutation happens at trace time only
+    print("tracing", x)    # host print: runs once, at trace time
+    x = x + np.random.rand()        # host RNG frozen into the program
+    return x * time.time()          # host clock desyncs SPMD processes
+
+
+def loss(params, x):
+    mode = os.environ.get("MODE", "")  # env frozen into the compiled fn
+    total = x.sum().item()             # host sync mid-trace
+    return total if mode else total
+
+
+loss_jit = jax.jit(loss)

@@ -1,0 +1,27 @@
+"""NON-FIRING fixture for jit-purity: the same shapes, done right."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, key):
+    noise = jax.random.normal(key, x.shape)  # traced RNG is fine
+    return x + jnp.tanh(noise)
+
+
+def loss(params, x):
+    return jnp.square(x - params).sum()
+
+
+loss_jit = jax.jit(loss)
+
+
+def host_driver(x):
+    # Host effects OUTSIDE any traced function are out of scope.
+    t0 = time.monotonic()
+    y = step(x, jax.random.key(0))
+    print("step took", time.monotonic() - t0)
+    return float(y.sum())

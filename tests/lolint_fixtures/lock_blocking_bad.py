@@ -1,0 +1,29 @@
+"""FIRING fixture for lock-blocking: blocking work under a hot lock."""
+
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+_doc = {}
+
+
+def flush(path):
+    with _lock:
+        with open(path, "w") as f:       # file I/O under the lock
+            json.dump(_doc, f)
+
+
+def backoff():
+    with _lock:
+        time.sleep(0.5)                  # every other thread now waits
+
+
+def reap(worker_thread):
+    with _lock:
+        worker_thread.join()             # join on a thread-ish receiver
+
+
+def swap(model, registry_lock):
+    with registry_lock:
+        model.save("params")             # orbax-save-shaped call

@@ -1,0 +1,40 @@
+"""NON-FIRING fixture for lock-blocking: snapshot under the lock, do
+the slow work outside it."""
+
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+_cond = threading.Condition()
+_doc = {}
+
+
+def flush(path):
+    with _lock:
+        snapshot = dict(_doc)            # cheap copy under the lock
+    with open(path, "w") as f:           # I/O after release
+        json.dump(snapshot, f)
+
+
+def backoff():
+    time.sleep(0.5)                      # sleeping un-locked is fine
+    with _lock:
+        _doc["woke"] = True
+
+
+def consume():
+    with _cond:
+        _cond.wait(timeout=1.0)          # wait() RELEASES the lock
+
+
+def schedule(pool):
+    with _lock:
+        def task():                      # nested def runs later,
+            time.sleep(0.1)              # lock-free — not flagged
+        pool.submit(task)
+
+
+def header(parts):
+    with _lock:
+        return ",".join(parts)           # str.join is not a thread join

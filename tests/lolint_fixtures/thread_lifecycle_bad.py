@@ -1,0 +1,9 @@
+"""FIRING fixture for thread-lifecycle: an anonymous, unowned thread."""
+
+import threading
+
+
+def start_worker(fn):
+    t = threading.Thread(target=fn, daemon=True)   # no name, no owner
+    t.start()
+    return t

@@ -1,0 +1,18 @@
+"""NON-FIRING fixture for thread-lifecycle: named + owned."""
+
+import threading
+
+
+def start_worker(fn, errors):
+    def run():
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001 — forwarded to owner
+            errors.append(exc)
+
+    # thread-lifecycle: owner=start_worker's caller; exits when fn
+    # returns; every exception is forwarded through ``errors`` and
+    # checked by the owner at join time; daemon.
+    t = threading.Thread(target=run, daemon=True, name="fx-worker")
+    t.start()
+    return t

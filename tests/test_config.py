@@ -1,0 +1,40 @@
+"""The dynamic config accessors — the single sanctioned home of
+LO_TPU_* reads outside Settings (lolint env-discipline)."""
+
+import pytest
+
+from learningorchestra_tpu import config
+
+
+def test_job_port_default_and_explicit(monkeypatch):
+    monkeypatch.delenv("LO_TPU_JOB_PORT", raising=False)
+    assert config.job_port(8477) == 8477
+    monkeypatch.setenv("LO_TPU_JOB_PORT", "9001")
+    assert config.job_port(8477) == 9001
+
+
+def test_job_port_malformed_raises_loudly(monkeypatch):
+    """A typo'd port must fail at startup naming the value — a silent
+    fallback would have coordinator and workers on different job-channel
+    ports, surfacing as an opaque handshake timeout."""
+    monkeypatch.setenv("LO_TPU_JOB_PORT", "8x77")
+    with pytest.raises(ValueError, match="LO_TPU_JOB_PORT.*8x77"):
+        config.job_port(8477)
+
+
+def test_counters_tolerate_garbage(monkeypatch):
+    """restart_count/mesh_epoch are display/scope ordinals read on hot
+    paths (every /cluster hit, every handshake): garbage degrades to 0
+    rather than turning a health probe into a 500."""
+    monkeypatch.setenv("LO_TPU_RESTART_COUNT", "not-a-number")
+    monkeypatch.setenv("LO_TPU_MESH_EPOCH", "")
+    assert config.restart_count() == 0
+    assert config.mesh_epoch() == 0
+
+
+def test_coordinator_address_default(monkeypatch):
+    monkeypatch.delenv("LO_TPU_COORDINATOR", raising=False)
+    assert config.coordinator_address() is None
+    assert config.coordinator_address("127.0.0.1:8476") == "127.0.0.1:8476"
+    monkeypatch.setenv("LO_TPU_COORDINATOR", "10.0.0.5:8476")
+    assert config.coordinator_address("x") == "10.0.0.5:8476"

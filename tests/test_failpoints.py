@@ -230,8 +230,8 @@ def test_scrub_detects_rot_after_first_read(tmp_path):
     assert report["ok"] and report["checked"] >= 1
     assert store2.integrity_snapshot()["chunks_repaired"] == 1
     # repaired file verifies against the journaled crc
-    rec = json.loads(open(os.path.join(
-        cfg.store_root, "d", "journal.jsonl")).readline())
+    with open(os.path.join(cfg.store_root, "d", "journal.jsonl")) as f:
+        rec = json.loads(f.readline())
     assert crc32_file(path) == rec["crc32"]
 
 
@@ -291,7 +291,8 @@ def test_legacy_journal_without_checksums_still_loads(tmp_path):
     store.create("d", columns={"x": np.arange(20, dtype=np.int64)})
     store.save("d")
     jpath = os.path.join(cfg.store_root, "d", "journal.jsonl")
-    recs = [json.loads(ln) for ln in open(jpath)]
+    with open(jpath) as f:
+        recs = [json.loads(ln) for ln in f]
     with open(jpath, "w") as f:
         for rec in recs:
             rec.pop("crc32", None)
@@ -321,7 +322,8 @@ def test_journal_truncation_recovers_to_prefix_at_every_byte(tmp_path):
     store.save("d")
     ds_dir = os.path.join(cfg.store_root, "d")
     jpath = os.path.join(ds_dir, "journal.jsonl")
-    full = open(jpath, "rb").read()
+    with open(jpath, "rb") as f:
+        full = f.read()
     lines = full.splitlines(keepends=True)
     assert len(lines) == 3
     # Recovery GCs chunk files the truncated journal orphans (correct —
@@ -375,7 +377,8 @@ def test_control_child_completes(tmp_path):
     _mk_csv(root)
     proc = _run_child(root, {})
     assert proc.returncode == 0, proc.stderr[-2000:]
-    done = json.load(open(os.path.join(root, "done.json")))
+    with open(os.path.join(root, "done.json")) as f:
+        done = json.load(f)
     assert done["tab_rows"] == 200 and done["ing_rows"] == 2000
 
 

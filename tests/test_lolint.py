@@ -1,0 +1,341 @@
+"""lolint — rule fixtures, suppression/baseline mechanics, CLI, and the
+cross-check that keeps the static failpoint rule honest against the
+runtime registry. The paired fixtures under tests/lolint_fixtures/ are
+parsed, never imported: each rule must FIRE on its ``_bad`` snippet and
+stay SILENT on its ``_good`` twin."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lolint import parse_source, run_lint  # noqa: E402
+from tools.lolint.core import Project  # noqa: E402
+from tools.lolint.engine import (  # noqa: E402
+    BASELINE_RULE, DIRECTIVE_RULE, DEFAULT_BASELINE)
+from tools.lolint.rules import (  # noqa: E402
+    ALL_RULES, FailpointCoverageRule, rule_names, rules_by_name)
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "lolint_fixtures")
+
+#: rule name -> (pretend repo path the snippet is checked under, stem).
+CASES = {
+    "jit-purity": ("learningorchestra_tpu/models/fx.py", "jit_purity"),
+    "lock-blocking": ("learningorchestra_tpu/serving/fx.py",
+                      "lock_blocking"),
+    "env-discipline": ("learningorchestra_tpu/serving/fx.py",
+                       "env_discipline"),
+    "thread-lifecycle": ("learningorchestra_tpu/fx.py",
+                         "thread_lifecycle"),
+    "handler-error-map": ("learningorchestra_tpu/serving/fx.py",
+                          "handler_error_map"),
+    "failpoint-coverage": ("learningorchestra_tpu/catalog/fx.py",
+                           "failpoint_coverage"),
+}
+
+
+def _fixture(stem, variant):
+    with open(os.path.join(FIXDIR, f"{stem}_{variant}.py"),
+              encoding="utf-8") as f:
+        return f.read()
+
+
+def _check(rule_name, variant):
+    relpath, stem = CASES[rule_name]
+    pf = parse_source(_fixture(stem, variant), relpath)
+    (rule,) = rules_by_name([rule_name])
+    assert rule.applies(relpath)
+    return list(rule.check(pf))
+
+
+# -- per-rule fixtures --------------------------------------------------------
+
+@pytest.mark.parametrize("rule_name", sorted(CASES))
+def test_bad_fixture_fires(rule_name):
+    findings = _check(rule_name, "bad")
+    assert findings, f"{rule_name} did not fire on its bad fixture"
+    assert all(f.rule == rule_name for f in findings)
+    assert all(f.line > 0 and f.message for f in findings)
+
+
+@pytest.mark.parametrize("rule_name", sorted(CASES))
+def test_good_fixture_clean(rule_name):
+    assert _check(rule_name, "good") == []
+
+
+def test_jit_purity_catches_each_effect_class():
+    msgs = "\n".join(f.message for f in _check("jit-purity", "bad"))
+    for needle in ("print", "np.random", "time.time", "os.environ",
+                   ".item()", "global"):
+        assert needle in msgs, f"jit-purity missed {needle}"
+
+
+def test_lock_blocking_names_the_lock_and_call():
+    findings = _check("lock-blocking", "bad")
+    blurbs = [f.message for f in findings]
+    assert any("open()" in m and "_lock" in m for m in blurbs)
+    assert any("time.sleep()" in m for m in blurbs)
+    assert any(".join()" in m for m in blurbs)
+    assert any(".save()" in m and "registry_lock" in m for m in blurbs)
+
+
+# -- finalize (whole-project) passes -----------------------------------------
+
+def _project_with(tmp_path, relpath, source):
+    project = Project(root=str(tmp_path))
+    project.files.append(parse_source(source, relpath))
+    return project
+
+
+def test_handler_error_map_flags_unmapped_exception_class(tmp_path):
+    (rule,) = rules_by_name(["handler-error-map"])
+    bad = _project_with(tmp_path, "learningorchestra_tpu/serving/fx.py",
+                        _fixture("handler_error_map", "bad"))
+    finds = list(rule.finalize(bad))
+    assert any("QueueFull" in f.message for f in finds)
+
+    good = _project_with(tmp_path, "learningorchestra_tpu/serving/fx.py",
+                         _fixture("handler_error_map", "good"))
+    assert list(rule.finalize(good)) == []
+
+
+def test_env_discipline_doc_coverage(tmp_path):
+    (rule,) = rules_by_name(["env-discipline"])
+    cfg_src = 'KNOB = _env("LO_TPU_FIXTURE_ONLY_KNOB", 1)\n'
+    project = _project_with(tmp_path, "learningorchestra_tpu/config.py",
+                            cfg_src)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "configuration.md").write_text("nothing about that knob\n")
+    finds = list(rule.finalize(project))
+    assert any("LO_TPU_FIXTURE_ONLY_KNOB" in f.message for f in finds)
+
+    (docs / "configuration.md").write_text(
+        "| `LO_TPU_FIXTURE_ONLY_KNOB` | 1 | documented now |\n")
+    assert list(rule.finalize(project)) == []
+
+
+# -- engine: suppressions + baseline -----------------------------------------
+
+_THREAD_SNIPPET = textwrap.dedent("""\
+    import threading
+
+
+    def start_worker(fn):
+        t = threading.Thread(target=fn, daemon=True){suffix}
+        t.start()
+        return t
+    """)
+
+
+def _mk_repo(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def test_engine_reports_the_violation(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "learningorchestra_tpu/w.py": _THREAD_SNIPPET.format(suffix="")})
+    res = run_lint(baseline_path=None, repo_root=root)
+    assert not res.ok
+    assert {f.rule for f in res.findings} == {"thread-lifecycle"}
+
+
+def test_inline_suppression_silences(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "learningorchestra_tpu/w.py": _THREAD_SNIPPET.format(
+            suffix="  # lolint: disable=thread-lifecycle")})
+    res = run_lint(baseline_path=None, repo_root=root)
+    assert res.ok, [f.render() for f in res.findings]
+
+
+def test_file_level_suppression_silences(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "learningorchestra_tpu/w.py":
+            "# lolint: disable-file=thread-lifecycle\n"
+            + _THREAD_SNIPPET.format(suffix="")})
+    res = run_lint(baseline_path=None, repo_root=root)
+    assert res.ok, [f.render() for f in res.findings]
+
+
+def test_unknown_rule_in_suppression_is_itself_an_error(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "learningorchestra_tpu/w.py":
+            "# lolint: disable-file=no-such-rule\nX = 1\n"})
+    res = run_lint(baseline_path=None, repo_root=root)
+    assert [f.rule for f in res.findings] == [DIRECTIVE_RULE]
+    assert "no-such-rule" in res.findings[0].message
+
+
+def _baseline(tmp_path, entries):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(entries))
+    return str(p)
+
+
+def test_justified_baseline_entry_silences(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "learningorchestra_tpu/w.py": _THREAD_SNIPPET.format(suffix="")})
+    bl = _baseline(tmp_path, [{
+        "rule": "thread-lifecycle",
+        "path": "learningorchestra_tpu/w.py",
+        "symbol": "start_worker",
+        "justification": "fixture: grandfathered on purpose"}])
+    res = run_lint(baseline_path=bl, repo_root=root)
+    assert res.ok, [f.render() for f in res.findings]
+    assert res.baseline_used == 1
+
+
+def test_baseline_entry_without_justification_fails(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "learningorchestra_tpu/w.py": _THREAD_SNIPPET.format(suffix="")})
+    bl = _baseline(tmp_path, [{
+        "rule": "thread-lifecycle",
+        "path": "learningorchestra_tpu/w.py",
+        "symbol": "start_worker",
+        "justification": "   "}])
+    res = run_lint(baseline_path=bl, repo_root=root)
+    assert any(f.rule == BASELINE_RULE and "justification" in f.message
+               for f in res.findings)
+    # ...and the unjustified entry does NOT silence the finding.
+    assert any(f.rule == "thread-lifecycle" for f in res.findings)
+
+
+def test_stale_baseline_entry_fails(tmp_path):
+    root = _mk_repo(tmp_path, {"learningorchestra_tpu/w.py": "X = 1\n"})
+    bl = _baseline(tmp_path, [{
+        "rule": "thread-lifecycle",
+        "path": "learningorchestra_tpu/w.py",
+        "symbol": "start_worker",
+        "justification": "the violation this excused is gone"}])
+    res = run_lint(baseline_path=bl, repo_root=root)
+    assert any(f.rule == BASELINE_RULE and "stale" in f.message
+               for f in res.findings)
+
+
+def test_scoped_runs_do_not_false_flag_baseline_stale(tmp_path):
+    """A paths- or rules-scoped run cannot see findings outside its
+    scope; baseline entries it did not cover must not be called stale
+    (they made every scoped CLI invocation fail)."""
+    root = _mk_repo(tmp_path, {
+        "learningorchestra_tpu/a.py": _THREAD_SNIPPET.format(suffix=""),
+        "learningorchestra_tpu/b.py": "X = 1\n"})
+    bl = _baseline(tmp_path, [{
+        "rule": "thread-lifecycle",
+        "path": "learningorchestra_tpu/a.py",
+        "symbol": "start_worker",
+        "justification": "fixture: grandfathered on purpose"}])
+    # Path subset that excludes a.py: entry out of scope, run clean.
+    res = run_lint(paths=["learningorchestra_tpu/b.py"],
+                   baseline_path=bl, repo_root=root)
+    assert res.ok, [f.render() for f in res.findings]
+    # Rule subset that excludes thread-lifecycle: same.
+    res = run_lint(rules=rules_by_name(["env-discipline"]),
+                   baseline_path=bl, repo_root=root)
+    assert res.ok, [f.render() for f in res.findings]
+    # Full run DOES use the entry (and stays clean).
+    res = run_lint(baseline_path=bl, repo_root=root)
+    assert res.ok and res.baseline_used == 1
+
+
+def test_scoped_run_on_real_repo_is_clean():
+    """The per-directory CLI form must work with the shipped baseline
+    (regression: scoped runs false-flagged every uncovered entry)."""
+    res = run_lint(paths=["learningorchestra_tpu/serving"])
+    assert res.ok, "\n".join(f.render() for f in res.findings)
+
+
+def test_baseline_with_unknown_rule_fails(tmp_path):
+    root = _mk_repo(tmp_path, {"learningorchestra_tpu/w.py": "X = 1\n"})
+    bl = _baseline(tmp_path, [{
+        "rule": "no-such-rule", "path": "p", "symbol": "s",
+        "justification": "x"}])
+    res = run_lint(baseline_path=bl, repo_root=root)
+    assert any(f.rule == BASELINE_RULE and "no-such-rule" in f.message
+               for f in res.findings)
+
+
+# -- the repo itself ----------------------------------------------------------
+
+def test_repo_tree_is_clean_under_the_shipped_baseline():
+    """The acceptance gate CI runs: zero non-baselined findings, zero
+    stale or unjustified baseline entries."""
+    res = run_lint()
+    assert res.ok, "\n".join(f.render() for f in res.findings)
+    assert res.files_scanned > 40
+
+
+def test_shipped_baseline_entries_all_carry_justifications():
+    with open(DEFAULT_BASELINE, encoding="utf-8") as f:
+        entries = json.load(f)
+    assert entries, "baseline exists and is non-trivial"
+    for ent in entries:
+        assert len(str(ent.get("justification", "")).split()) >= 5, (
+            f"baseline entry {ent.get('rule')}@{ent.get('path')} needs a "
+            "real written justification")
+
+
+def test_static_failpoint_sites_match_runtime_registry():
+    """Every ``CONST = failpoints.declare(...)`` the rule sees statically
+    in catalog/ must be registered in the live introspectable registry —
+    the cross-check that keeps the AST view and runtime truth aligned."""
+    # Import for the side effect of running every declare().
+    import learningorchestra_tpu.catalog.dataset  # noqa: F401
+    import learningorchestra_tpu.catalog.ingest  # noqa: F401
+    import learningorchestra_tpu.catalog.store  # noqa: F401
+    from learningorchestra_tpu.utils import failpoints
+
+    registered = set(failpoints.sites())
+    pkg = os.path.join(REPO, "learningorchestra_tpu", "catalog")
+    static = {}
+    for fn in sorted(os.listdir(pkg)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(pkg, fn), encoding="utf-8") as f:
+            pf = parse_source(f.read(),
+                              f"learningorchestra_tpu/catalog/{fn}")
+        static.update(FailpointCoverageRule.declared_sites(pf))
+    assert static, "catalog/ declares failpoint sites"
+    missing = {s for s in static.values() if s not in registered}
+    assert not missing, f"declared statically but not registered: {missing}"
+    assert "store.save.pre_meta_swap" in static.values()
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_json_clean_run(capsys):
+    from tools.lolint.__main__ import main
+
+    assert main(["--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True
+    assert doc["findings"] == []
+    assert doc["baseline_entries_used"] >= 1
+
+
+def test_cli_list_rules_and_bad_rule_name(capsys):
+    from tools.lolint.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in rule_names():
+        assert name in out
+    assert main(["--rules", "bogus"]) == 2
+
+
+def test_every_rule_has_fixture_coverage():
+    """Adding a rule without a paired fixture is itself a failure."""
+    assert sorted(CASES) == sorted(r.name for r in ALL_RULES)
+    for stem in (s for _, s in CASES.values()):
+        for variant in ("bad", "good"):
+            assert os.path.isfile(
+                os.path.join(FIXDIR, f"{stem}_{variant}.py"))

@@ -123,7 +123,8 @@ def test_incremental_commit_never_rewrites_chunks(cfg, tmp_path):
     assert (stat0b.st_mtime_ns, stat0b.st_size) == sig0  # not rewritten
 
     journal = os.path.join(cfg.store_root, "inc", "journal.jsonl")
-    recs = [json.loads(line) for line in open(journal)]
+    with open(journal) as f:
+        recs = [json.loads(line) for line in f]
     assert [r["rows"] for r in recs] == [100, 100, 100, 100]
 
     store2 = DatasetStore(cfg)
@@ -159,7 +160,8 @@ def test_crash_recovery_replays_journal_prefix(cfg):
     store.save("cr")
     # Crash: second journal line torn mid-write, orphan chunk file left.
     journal = os.path.join(cfg.store_root, "cr", "journal.jsonl")
-    lines = open(journal).read().splitlines()
+    with open(journal) as f:
+        lines = f.read().splitlines()
     with open(journal, "w") as f:
         f.write(lines[0] + "\n" + lines[1][: len(lines[1]) // 2])
 
@@ -223,7 +225,8 @@ def test_set_column_under_budget_is_safe(budget_cfg, tmp_path):
     # New generation committed; journal and files agree.
     chunk_dir = os.path.join(budget_cfg.store_root, "c", "chunks")
     journal = os.path.join(budget_cfg.store_root, "c", "journal.jsonl")
-    recs = [json.loads(line) for line in open(journal)]
+    with open(journal) as f:
+        recs = [json.loads(line) for line in f]
     assert sorted(os.listdir(chunk_dir)) == sorted(r["file"] for r in recs)
     assert all(r["file"].startswith("001-") for r in recs)
 
@@ -356,7 +359,8 @@ def test_gc_defers_while_streaming_reader_active(cfg, tmp_path):
     store.save("g")
     chunk_dir = os.path.join(cfg.store_root, "g", "chunks")
     journal = os.path.join(cfg.store_root, "g", "journal.jsonl")
-    recs = [json.loads(line) for line in open(journal)]
+    with open(journal) as f:
+        recs = [json.loads(line) for line in f]
     assert sorted(os.listdir(chunk_dir)) == sorted(r["file"] for r in recs)
 
 
@@ -521,7 +525,8 @@ def test_mirror_restart_does_not_duplicate_journal(cfg, tmp_path):
     store2.save("dj")
 
     rep_journal = os.path.join(cfg.replica_root, "dj", "journal.jsonl")
-    recs = [json.loads(line) for line in open(rep_journal)]
+    with open(rep_journal) as f:
+        recs = [json.loads(line) for line in f]
     assert [r["rows"] for r in recs] == [10, 10]  # no duplicates
     import shutil
     shutil.rmtree(cfg.store_root)

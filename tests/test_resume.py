@@ -229,6 +229,8 @@ def test_kill9_mid_ingest_then_resume(cfg, tmp_path):
         pytest.fail("child never committed two chunks")
     os.kill(proc.pid, signal.SIGKILL)
     proc.wait()
+    proc.stdout.close()   # SIGKILL path never communicate()s; close the
+    proc.stderr.close()   # pipes or their GC trips the warning gate
 
     cfg.ingest_chunk_rows = 500
     cfg.ingest_commit_bytes = 0

@@ -311,6 +311,8 @@ def test_client_times_out_on_hung_server():
         with pytest.raises(requests.Timeout):
             DatabaseApi(ctx).read_files_descriptor()
     finally:
+        for conn, _addr in conns:   # accepted side of the hung request:
+            conn.close()            # GC'd open sockets trip -W error
         hung.close()
 
 

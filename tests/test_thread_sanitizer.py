@@ -1,0 +1,86 @@
+"""The runtime thread sanitizer (tests/conftest.py) — dynamic backstop
+for lolint's static thread-lifecycle rule.
+
+PR 6's dispatcher thread died with an uncaught exception and silently
+black-holed its model until restart; six review rounds later the fix
+landed, but nothing in the harness would have CAUGHT the class. These
+tests re-create that exact shape — a named background loop thread
+killed by an unexpected exception — and assert the conftest
+``threading.excepthook`` harness records the death and fails the
+owning test."""
+
+import sys
+import threading
+
+import pytest
+
+
+def _die_like_a_dispatcher():
+    """The PR 6 shape: a per-model dispatch loop hits an exception
+    outside its per-group try/except and unwinds the whole thread."""
+    queue = [object()]
+    while queue:
+        batch = queue.pop()
+        raise RuntimeError(f"dispatch loop died on {batch!r}")
+
+
+def _start_doomed_dispatcher():
+    # thread-lifecycle annotation deliberately absent: this is test
+    # code, outside lolint's package scope.
+    t = threading.Thread(target=_die_like_a_dispatcher,
+                         daemon=True, name="lo-predict-doomed")
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+    return t
+
+
+def test_sanitizer_records_silent_dispatcher_death(thread_sanitizer):
+    deaths_before = thread_sanitizer.drain()
+    assert deaths_before == []
+    _start_doomed_dispatcher()
+    deaths = thread_sanitizer.drain()  # drained ⇒ THIS test stays green
+    assert len(deaths) == 1
+    d = deaths[0]
+    assert d.name == "lo-predict-doomed"
+    assert d.exc_type is RuntimeError
+    assert "dispatch loop died" in d.traceback
+    assert "_die_like_a_dispatcher" in d.traceback
+
+
+def test_sanitizer_fails_the_owning_test(thread_sanitizer):
+    """The gate itself: an undrained death must fail the test it
+    happened under, naming the thread and carrying the traceback."""
+    _start_doomed_dispatcher()
+    with pytest.raises(pytest.fail.Exception) as exc:
+        thread_sanitizer.fail_if_deaths("this-test")
+    msg = str(exc.value)
+    assert "lo-predict-doomed" in msg
+    assert "dispatch loop died" in msg
+    assert "PR 6" in msg
+    # fail_if_deaths drained the record, so the autouse gate passes.
+    assert thread_sanitizer.drain() == []
+
+
+@pytest.mark.allow_thread_death
+def test_allow_thread_death_marker_opts_out(thread_sanitizer):
+    """A test that deliberately kills a background thread can opt out;
+    the autouse gate drains the record instead of failing."""
+    _start_doomed_dispatcher()
+    # No drain here: the marker must absorb the recorded death.
+    assert thread_sanitizer._deaths  # recorded, pending at teardown
+
+
+def test_marker_left_no_residue(thread_sanitizer):
+    """Runs after the opt-out test in file order: its absorbed death
+    must not leak into later tests (the gate pre-drains too, but the
+    marker path itself should have cleaned up)."""
+    assert thread_sanitizer.drain() == []
+
+
+def test_systemexit_in_thread_is_not_a_death(thread_sanitizer):
+    """sys.exit() in a worker matches the stdlib hook's own carve-out."""
+    t = threading.Thread(target=sys.exit, daemon=True, name="lo-exiting")
+    t.start()
+    t.join(10)
+    assert thread_sanitizer.drain() == []

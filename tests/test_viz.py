@@ -75,18 +75,23 @@ def _exact_joint_P(X, perplexity=30.0):
     target = np.log(perplexity)
     P = np.zeros((n, n))
     for i in range(n):
+        # The inf self-distance must be excluded from the entropy term:
+        # inf * exp(-inf) = nan would otherwise poison h on every
+        # iteration and the bisection would never calibrate beta (the
+        # filterwarnings=error gate surfaced exactly this).
+        d2_i = np.delete(d2[i], i)
         lo, hi, beta = 0.0, np.inf, 1.0
         for _ in range(60):
-            w = np.exp(-d2[i] * beta)
+            w = np.exp(-d2_i * beta)
             s = w.sum()
-            h = np.log(s) + beta * (d2[i] * w).sum() / s
+            h = np.log(s) + beta * (d2_i * w).sum() / s
             if h > target:
                 lo = beta
                 beta = beta * 2.0 if np.isinf(hi) else (lo + hi) / 2.0
             else:
                 hi = beta
                 beta = (lo + hi) / 2.0
-        P[i] = w / s
+        P[i] = np.insert(w / s, i, 0.0)   # self-affinity is 0 by definition
     P = (P + P.T) / (2.0 * n)
     return np.maximum(P, 1e-12)
 

@@ -1,0 +1,32 @@
+"""lolint — the project-invariant static analyzer.
+
+Six review rounds on PR 6 hand-caught the same defect classes over and
+over: blocking work under hot locks, silent dispatcher-thread death,
+raw ``TypeError`` → 500 in handlers, and ``LO_TPU_*`` env reads
+scattered outside ``config.py``. lolint encodes those hard-won
+invariants as machine-checkable AST rules and gates CI on them
+(docs/static_analysis.md has the rule table with the review findings
+that motivated each one).
+
+Usage::
+
+    python -m tools.lolint [paths...] [--json] [--no-baseline]
+
+Findings can be silenced two ways, both audited:
+
+- inline, for a deliberate one-off: ``# lolint: disable=<rule>`` on the
+  offending line (an unknown rule name in the directive is itself an
+  error, so typos cannot silently disable nothing);
+- the baseline file (``tools/lolint/baseline.json``) for grandfathered
+  findings, keyed (rule, file, enclosing symbol) so they survive
+  line-number drift — every entry MUST carry a written justification,
+  and stale entries (matching nothing) fail the run so the file can
+  only shrink honestly.
+"""
+
+from tools.lolint.core import Finding, ParsedFile, Project, parse_source
+from tools.lolint.engine import run_lint
+from tools.lolint.rules import ALL_RULES, rule_names
+
+__all__ = ["Finding", "ParsedFile", "Project", "parse_source",
+           "run_lint", "ALL_RULES", "rule_names"]

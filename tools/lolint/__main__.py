@@ -1,0 +1,70 @@
+"""CLI: ``python -m tools.lolint [paths...]``.
+
+Exit codes: 0 clean (after suppressions + baseline), 1 findings, 2 bad
+invocation. ``--json`` emits the machine-readable report CI artifacts
+consume; the default text form is one clickable ``path:line:col`` per
+finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.lolint.engine import (
+    DEFAULT_BASELINE, REPO_ROOT, run_lint)
+from tools.lolint.rules import ALL_RULES, rule_names, rules_by_name
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lolint",
+        description="lolint — this repo's project-invariant static "
+                    "analyzer (docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--rules", help="comma-separated subset of rules")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/lolint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name:20s} {r.description}")
+        return 0
+
+    try:
+        rules = rules_by_name(
+            [s.strip() for s in args.rules.split(",")] if args.rules
+            else None)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    result = run_lint(
+        paths=args.paths or None, rules=rules,
+        baseline_path=None if args.no_baseline else args.baseline,
+        repo_root=REPO_ROOT)
+
+    if args.as_json:
+        print(json.dumps(result.to_doc(), indent=1))
+    else:
+        for f in result.findings:
+            print(f.render())
+        counts = ", ".join(f"{k}={v}" for k, v in result.counts().items())
+        print(f"lolint: {len(result.findings)} finding(s) "
+              f"[{counts or 'clean'}] across {result.files_scanned} "
+              f"file(s); known rules: {', '.join(rule_names())}")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

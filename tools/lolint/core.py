@@ -1,0 +1,223 @@
+"""lolint core: parsed-file model, AST helpers, suppression directives.
+
+Everything here is rule-agnostic plumbing. A :class:`ParsedFile` bundles
+one module's AST with the comment/suppression index rules need;
+:class:`Project` is the whole-tree view for cross-file checks (doc
+coverage, exception-map completeness, failpoint registry cross-checks).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+#: ``# lolint: disable=rule-a,rule-b`` — suppress those rules on this
+#: line. ``disable-file=`` widens the suppression to the whole file.
+_DIRECTIVE_RE = re.compile(
+    r"#\s*lolint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\- ]+)")
+
+
+@dataclass
+class Finding:
+    """One rule violation at a source location. ``symbol`` is the
+    enclosing function/class qualname — the stable anchor baseline
+    entries key on (line numbers drift; symbols rarely do)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = ""
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}{where}")
+
+    def to_doc(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "symbol": self.symbol}
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.random.normal`` for an Attribute/Name chain; "" when the
+    expression is not a plain dotted name (subscripts, calls, …).
+    ``a().b`` renders "().b" — callers match on suffix/prefix, so an
+    intermediate call degrades to a miss, never a crash."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else f"().{node.attr}"
+    if isinstance(node, ast.Call):
+        return "()"
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted_name(node.func)
+
+
+def iter_body_calls(node: ast.AST,
+                    enter_functions: bool = False) -> Iterator[ast.Call]:
+    """Calls lexically inside ``node``'s body. By default nested
+    function/lambda definitions are NOT entered — including when
+    ``node`` itself is one: code inside them runs when *they* are
+    called, not while the enclosing block (e.g. a held lock) executes."""
+    if not enter_functions and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    for child in ast.iter_child_nodes(node):
+        if not enter_functions and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(child, ast.Call):
+            yield child
+        yield from iter_body_calls(child, enter_functions)
+
+
+class ParsedFile:
+    """One source file, parsed once, with the indexes every rule needs."""
+
+    def __init__(self, path: str, source: str):
+        #: Repo-relative posix path — what findings and baselines carry.
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        #: line number -> full comment text on that line.
+        self.comments: Dict[int, str] = {}
+        #: line -> set of rule names disabled on that line.
+        self.suppressions: Dict[int, Set[str]] = {}
+        #: rule names disabled for the whole file.
+        self.file_suppressions: Set[str] = set()
+        #: (line, text) of every lolint directive — validated by the
+        #: engine against the rule registry (a typo'd rule name must be
+        #: an error, not a silent no-op).
+        self.directives: List[Tuple[int, str]] = []
+        self._scan_comments()
+        #: node -> enclosing qualname, filled lazily.
+        self._qualnames: Dict[int, str] = {}
+        self._index_symbols()
+        #: module-level NAME = "string constant" assignments (lets rules
+        #: resolve e.g. ``os.environ.get(ENV_VAR)``).
+        self.str_constants: Dict[str, str] = {}
+        for stmt in self.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                self.str_constants[stmt.targets[0].id] = stmt.value.value
+
+    # -- comments / suppressions ---------------------------------------------
+
+    def _scan_comments(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                line = tok.start[0]
+                self.comments[line] = tok.string
+                m = _DIRECTIVE_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group("rules").split(",")
+                         if r.strip()}
+                self.directives.append((line, ",".join(sorted(rules))))
+                if m.group("scope"):
+                    self.file_suppressions |= rules
+                else:
+                    self.suppressions.setdefault(line, set()).update(rules)
+        except tokenize.TokenError:
+            pass  # ast.parse succeeded; a tokenize hiccup only loses comments
+
+    def comment_near(self, line: int) -> str:
+        """Concatenated comment text attached to ``line``: the comment
+        on the line itself plus the contiguous run of commented lines
+        directly above — where (possibly multi-line) ownership
+        annotations live. A blank/uncommented line ends the run, so a
+        stray annotation further up never excuses an unrelated site."""
+        parts = [self.comments.get(line, "")]
+        ln = line - 1
+        while ln >= 1 and ln in self.comments:
+            parts.append(self.comments[ln])
+            ln -= 1
+        return " ".join(reversed(parts))
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_suppressions:
+            return True
+        return finding.rule in self.suppressions.get(finding.line, set())
+
+    # -- symbols -------------------------------------------------------------
+
+    def _index_symbols(self) -> None:
+        def visit(node: ast.AST, stack: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                name = getattr(child, "name", None)
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    child_stack = stack + [name]
+                else:
+                    child_stack = stack
+                self._qualnames[id(child)] = ".".join(child_stack)
+                visit(child, child_stack)
+
+        self._qualnames[id(self.tree)] = ""
+        visit(self.tree, [])
+
+    def symbol_of(self, node: ast.AST) -> str:
+        """Qualname of the symbol *containing* ``node`` ("" = module).
+        For a FunctionDef/ClassDef node itself, that includes its own
+        name — findings on a def anchor to the def."""
+        return self._qualnames.get(id(node), "")
+
+    def functions(self) -> Iterator[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+@dataclass
+class Project:
+    """Whole-tree context handed to rule ``finalize`` hooks."""
+
+    root: str
+    files: List[ParsedFile] = field(default_factory=list)
+
+    def by_path(self, path: str) -> Optional[ParsedFile]:
+        for pf in self.files:
+            if pf.path == path:
+                return pf
+        return None
+
+    def docs_text(self) -> str:
+        """Concatenated markdown under <root>/docs — the doc-coverage
+        corpus for env-discipline."""
+        chunks = []
+        docs = os.path.join(self.root, "docs")
+        if os.path.isdir(docs):
+            for fn in sorted(os.listdir(docs)):
+                if fn.endswith(".md"):
+                    with open(os.path.join(docs, fn), encoding="utf-8") as f:
+                        chunks.append(f.read())
+        return "\n".join(chunks)
+
+
+def parse_source(source: str, relpath: str) -> ParsedFile:
+    """Parse an in-memory source blob under a pretend repo path — how
+    the fixture tests run scoped rules on snippets that live outside
+    the scoped directories."""
+    return ParsedFile(relpath, source)

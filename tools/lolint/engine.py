@@ -1,0 +1,206 @@
+"""lolint engine: file walking, suppression validation, the justified
+baseline, and the run entry point the CLI and tests share.
+
+Silencing policy (docs/static_analysis.md §policy):
+
+- inline ``# lolint: disable=<rule>`` — for deliberate one-offs, visible
+  in review next to the code it excuses; unknown rule names in a
+  directive are themselves findings (rule ``lolint-directive``).
+- the baseline file — for grandfathered findings, keyed
+  ``(rule, path, symbol)``. Every entry needs a non-empty
+  ``justification``; an entry that matches no current finding is STALE
+  and fails the run, so the baseline can only shrink honestly (fixing a
+  violation forces deleting its excuse).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.lolint.core import Finding, ParsedFile, Project
+from tools.lolint.rules import ALL_RULES, Rule, rule_names
+
+#: Meta-rules emitted by the engine itself (never suppressible).
+DIRECTIVE_RULE = "lolint-directive"
+BASELINE_RULE = "lolint-baseline"
+PARSE_RULE = "lolint-parse"
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+#: What a bare ``python -m tools.lolint`` scans.
+DEFAULT_ROOTS = ("learningorchestra_tpu",)
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    baseline_used: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_doc(self) -> Dict[str, object]:
+        return {"ok": self.ok,
+                "files_scanned": self.files_scanned,
+                "baseline_entries_used": self.baseline_used,
+                "counts": self.counts(),
+                "findings": [f.to_doc() for f in self.findings]}
+
+
+def _iter_py_files(roots: Sequence[str], repo_root: str) -> List[str]:
+    out: List[str] = []
+    for root in roots:
+        top = root if os.path.isabs(root) else os.path.join(repo_root, root)
+        if os.path.isfile(top):
+            out.append(top)
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def _relpath(path: str, repo_root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), repo_root)
+    return rel.replace(os.sep, "/")
+
+
+def load_baseline(path: str) -> Tuple[List[dict], List[Finding]]:
+    """Baseline entries + findings for malformed ones (missing
+    justification, unknown rule, bad shape)."""
+    if not os.path.isfile(path):
+        return [], []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries, problems = [], []
+    known = set(rule_names())
+    bl_rel = _relpath(path, REPO_ROOT)
+    for i, ent in enumerate(doc):
+        where = f"baseline entry #{i}"
+        if not isinstance(ent, dict) or not {
+                "rule", "path", "symbol"} <= set(ent):
+            problems.append(Finding(
+                BASELINE_RULE, bl_rel, 1, 0,
+                f"{where} must be an object with rule/path/symbol/"
+                "justification keys"))
+            continue
+        if ent["rule"] not in known:
+            problems.append(Finding(
+                BASELINE_RULE, bl_rel, 1, 0,
+                f"{where} names unknown rule {ent['rule']!r}"))
+            continue
+        if not str(ent.get("justification", "")).strip():
+            problems.append(Finding(
+                BASELINE_RULE, bl_rel, 1, 0,
+                f"{where} ({ent['rule']} @ {ent['path']}:{ent['symbol']}) "
+                "has no justification — every grandfathered finding "
+                "carries its written excuse"))
+            continue
+        entries.append(ent)
+    return entries, problems
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[Rule]] = None,
+             baseline_path: Optional[str] = DEFAULT_BASELINE,
+             repo_root: str = REPO_ROOT) -> LintResult:
+    """Lint ``paths`` (repo-relative or absolute; default: the package)
+    and fold in suppressions + baseline. This is the single entry point
+    the CLI, CI and the test suite all call."""
+    rules = list(rules if rules is not None else ALL_RULES)
+    known_rules = {r.name for r in rules} | {
+        r.name for r in ALL_RULES}
+    result = LintResult()
+    project = Project(root=repo_root)
+
+    for path in _iter_py_files(paths or DEFAULT_ROOTS, repo_root):
+        rel = _relpath(path, repo_root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            pf = ParsedFile(rel, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            result.findings.append(Finding(
+                PARSE_RULE, rel, getattr(e, "lineno", 1) or 1, 0,
+                f"file does not parse: {e}"))
+            continue
+        project.files.append(pf)
+        result.files_scanned += 1
+
+    raw: List[Finding] = []
+    for pf in project.files:
+        for rule in rules:
+            if rule.applies(pf.path):
+                raw.extend(rule.check(pf))
+        # A directive naming an unknown rule is an error in its own
+        # right: the author believes something is suppressed and it
+        # is not (or never will be).
+        for line, spec in pf.directives:
+            for name in spec.split(","):
+                if name not in known_rules:
+                    result.findings.append(Finding(
+                        DIRECTIVE_RULE, pf.path, line, 0,
+                        f"suppression names unknown rule {name!r} "
+                        f"(known: {sorted(known_rules)})"))
+    for rule in rules:
+        raw.extend(rule.finalize(project))
+
+    # Inline suppressions.
+    by_path = {pf.path: pf for pf in project.files}
+    survivors = []
+    for f in raw:
+        pf = by_path.get(f.path)
+        if pf is not None and pf.suppressed(f):
+            continue
+        survivors.append(f)
+
+    # Baseline.
+    if baseline_path:
+        entries, problems = load_baseline(baseline_path)
+        result.findings.extend(problems)
+        keys = {(e["rule"], e["path"], e["symbol"]): e for e in entries}
+        used: Set[Tuple[str, str, str]] = set()
+        remaining = []
+        for f in survivors:
+            if f.baseline_key() in keys:
+                used.add(f.baseline_key())
+            else:
+                remaining.append(f)
+        survivors = remaining
+        result.baseline_used = len(used)
+        bl_rel = _relpath(baseline_path, repo_root)
+        # Staleness is only judgeable when this run actually covered the
+        # entry: a scoped invocation (a paths subset or --rules subset)
+        # simply cannot see findings outside its scope, and flagging
+        # those entries stale would make every scoped run fail.
+        scanned = {pf.path for pf in project.files}
+        active = {r.name for r in rules}
+        for key, ent in keys.items():
+            if key in used or key[1] not in scanned or key[0] not in active:
+                continue
+            result.findings.append(Finding(
+                BASELINE_RULE, bl_rel, 1, 0,
+                f"stale baseline entry {key[0]} @ {key[1]}:"
+                f"{key[2]} matches no current finding — delete it "
+                "(the violation it excused is gone)"))
+
+    result.findings.extend(survivors)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result

@@ -1,0 +1,538 @@
+"""The six lolint rules — this repo's hard-won invariants as AST checks.
+
+Each rule is a class with a ``name``, an ``applies(relpath)`` scope, a
+per-file ``check(pf)`` and an optional whole-tree ``finalize(project)``.
+docs/static_analysis.md carries the rule table with the PR-6/7 review
+finding that motivated each one; keep the two in sync when adding rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.lolint.core import (
+    Finding, ParsedFile, Project, call_name, dotted_name, iter_body_calls)
+
+PACKAGE = "learningorchestra_tpu"
+
+
+def _in(relpath: str, *prefixes: str) -> bool:
+    return relpath.startswith(prefixes)
+
+
+class Rule:
+    name = ""
+    description = ""
+
+    def applies(self, relpath: str) -> bool:
+        raise NotImplementedError
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        return iter(())
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+#: Callables that put a function under JAX tracing: code inside runs at
+#: TRACE time (once, host-side) not at execution time — host effects
+#: silently freeze into the program or desync SPMD processes.
+_JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pallas_call"}
+
+#: Host-effect calls that must not appear inside traced code.
+_IMPURE_CALL_PREFIXES = (
+    "np.random.", "numpy.random.", "random.", "time.", "requests.",
+)
+_IMPURE_CALLS = {"print", "open", "os.getenv", "os.urandom", "input"}
+#: Method names that force a host sync / host value inside a trace.
+_IMPURE_ATTR_CALLS = {"item", "block_until_ready", "tolist"}
+
+
+def _jit_wrapper_target(call: ast.Call) -> Optional[ast.AST]:
+    """For ``jax.jit(fn, ...)`` / ``partial(jax.jit, fn)`` /
+    ``pl.pallas_call(kernel, ...)``, the wrapped function expression."""
+    name = call_name(call)
+    last = name.rsplit(".", 1)[-1]
+    if last in _JIT_WRAPPERS and call.args:
+        return call.args[0]
+    if last == "partial" and call.args:
+        inner = call.args[0]
+        if (isinstance(inner, (ast.Name, ast.Attribute)) and
+                dotted_name(inner).rsplit(".", 1)[-1] in _JIT_WRAPPERS):
+            return call.args[1] if len(call.args) > 1 else None
+    return None
+
+
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = ("no print/np.random/time/os.environ/.item()/global "
+                   "mutation inside jit/pjit/shard_map/Pallas-traced "
+                   "functions")
+
+    def applies(self, relpath: str) -> bool:
+        return _in(relpath, PACKAGE)
+
+    def _traced_functions(self, pf: ParsedFile) -> List[ast.AST]:
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in pf.functions():
+            by_name.setdefault(fn.name, []).append(fn)
+        traced: Dict[int, ast.AST] = {}
+
+        def mark(node: Optional[ast.AST]) -> None:
+            if node is None:
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                traced[id(node)] = node
+            elif isinstance(node, ast.Name):
+                for fn in by_name.get(node.id, ()):
+                    traced[id(fn)] = fn
+
+        for fn in pf.functions():
+            for deco in fn.decorator_list:
+                dname = dotted_name(deco).rsplit(".", 1)[-1]
+                if dname in _JIT_WRAPPERS:
+                    traced[id(fn)] = fn
+                elif isinstance(deco, ast.Call):
+                    last = call_name(deco).rsplit(".", 1)[-1]
+                    if last in _JIT_WRAPPERS:
+                        traced[id(fn)] = fn
+                    elif last == "partial" and deco.args:
+                        inner = dotted_name(deco.args[0]).rsplit(".", 1)[-1]
+                        if inner in _JIT_WRAPPERS:
+                            traced[id(fn)] = fn
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call):
+                mark(_jit_wrapper_target(node))
+        return list(traced.values())
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        for fn in self._traced_functions(pf):
+            sym = pf.symbol_of(fn) or getattr(fn, "name", "<lambda>")
+            # The whole lexical subtree is traced — nested defs/lambdas
+            # inside a jitted function execute under the same trace.
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    yield Finding(
+                        self.name, pf.path, node.lineno, node.col_offset,
+                        "global-statement inside a traced function: "
+                        "mutation happens at trace time, not per call",
+                        sym)
+                if isinstance(node, ast.Attribute) and \
+                        dotted_name(node) == "os.environ":
+                    yield Finding(
+                        self.name, pf.path, node.lineno, node.col_offset,
+                        "os.environ read inside a traced function freezes "
+                        "the env value into the compiled program", sym)
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                short = cname.rsplit(".", 1)[-1]
+                if cname in _IMPURE_CALLS or any(
+                        cname.startswith(p) for p in _IMPURE_CALL_PREFIXES):
+                    # jax.random / jax.numpy are fine; host RNG/clock/IO
+                    # is what desyncs traces.
+                    yield Finding(
+                        self.name, pf.path, node.lineno, node.col_offset,
+                        f"host-effect call {cname}() inside a traced "
+                        "function (runs at trace time / desyncs SPMD "
+                        "processes)", sym)
+                elif (isinstance(node.func, ast.Attribute)
+                      and short in _IMPURE_ATTR_CALLS
+                      and not cname.startswith(("np.", "numpy."))):
+                    yield Finding(
+                        self.name, pf.path, node.lineno, node.col_offset,
+                        f".{short}() inside a traced function forces a "
+                        "host sync mid-trace", sym)
+
+
+# ---------------------------------------------------------------------------
+# lock-blocking
+# ---------------------------------------------------------------------------
+
+#: Held-lock context expressions are recognized by name: the repo's
+#: locks are uniformly *_lock / _cond / name_lock (threading.Lock /
+#: Condition attributes).
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|cond|mutex)$", re.IGNORECASE)
+
+_BLOCKING_PREFIXES = ("requests.", "shutil.", "subprocess.", "socket.",
+                      "urllib.")
+_BLOCKING_EXACT = {"time.sleep", "os.replace", "os.rename", "os.fsync",
+                   "os.remove", "os.makedirs", "json.dump", "json.load"}
+#: Method names that dispatch device work, do I/O, or block regardless
+#: of receiver. ``join`` is special-cased to thread-ish receivers so
+#: ``",".join(...)`` stays clean.
+_BLOCKING_ATTRS = {"block_until_ready", "device_put", "lower", "compile",
+                   "restore", "save", "load", "result", "serve_forever",
+                   "sleep"}
+
+
+class LockBlockingRule(Rule):
+    name = "lock-blocking"
+    description = ("no device dispatch, file/network I/O, orbax "
+                   "save/load, sleep or thread joins while holding a "
+                   "lock on the serving/catalog hot paths")
+
+    SCOPE = (
+        f"{PACKAGE}/serving/",
+        f"{PACKAGE}/catalog/readpipe.py",
+        f"{PACKAGE}/models/aot.py",
+        f"{PACKAGE}/models/registry.py",
+        f"{PACKAGE}/models/persistence.py",
+    )
+
+    def applies(self, relpath: str) -> bool:
+        return _in(relpath, *self.SCOPE)
+
+    @staticmethod
+    def _held_lock(item: ast.withitem) -> Optional[str]:
+        name = dotted_name(item.context_expr)
+        if not name:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        return name if _LOCK_NAME_RE.search(last) else None
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = [self._held_lock(i) for i in node.items]
+            locks = [x for x in locks if x]
+            if not locks:
+                continue
+            sym = pf.symbol_of(node)
+            for stmt in node.body:
+                # Nested defs are skipped: they run later, lock-free.
+                for call in iter_body_calls(stmt):
+                    yield from self._check_call(pf, call, locks[0], sym)
+
+    def _check_call(self, pf: ParsedFile, call: ast.Call, lock: str,
+                    sym: str) -> Iterator[Finding]:
+        cname = call_name(call)
+        short = cname.rsplit(".", 1)[-1]
+        receiver = cname.rsplit(".", 1)[0] if "." in cname else ""
+        blocking = None
+        if cname == "open" or cname in _BLOCKING_EXACT or any(
+                cname.startswith(p) for p in _BLOCKING_PREFIXES):
+            blocking = f"{cname}()"
+        elif isinstance(call.func, ast.Attribute):
+            if short in _BLOCKING_ATTRS:
+                # cond.wait() RELEASES the lock — that is the whole
+                # point of a condition variable; never flag it. (wait
+                # is not in the set, this comment documents why.)
+                blocking = f".{short}()"
+            elif short == "join" and re.search(
+                    r"thread|proc|worker|pool", receiver, re.IGNORECASE):
+                blocking = f".{short}()"
+        if blocking:
+            yield Finding(
+                self.name, pf.path, call.lineno, call.col_offset,
+                f"{blocking} while holding {lock}: blocking work under a "
+                "hot lock head-of-line-stalls every other thread on it "
+                "(the PR 6 registry-version stall class)", sym)
+
+
+# ---------------------------------------------------------------------------
+# env-discipline
+# ---------------------------------------------------------------------------
+
+class EnvDisciplineRule(Rule):
+    name = "env-discipline"
+    description = ("every LO_TPU_* env read goes through config.py, and "
+                   "every knob config.py names appears in docs/")
+
+    CONFIG = f"{PACKAGE}/config.py"
+
+    def applies(self, relpath: str) -> bool:
+        return _in(relpath, PACKAGE) and relpath != self.CONFIG
+
+    @staticmethod
+    def _env_key(pf: ParsedFile, node: ast.AST) -> Optional[str]:
+        """The env-var key of an os.environ/os.getenv access, resolving
+        module-level string constants; None when not an env read or the
+        key is dynamic."""
+        key_expr: Optional[ast.AST] = None
+        if isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname == "os.getenv" and node.args:
+                key_expr = node.args[0]
+            elif cname in ("os.environ.get", "environ.get") and node.args:
+                key_expr = node.args[0]
+        elif isinstance(node, ast.Subscript) and dotted_name(
+                node.value) in ("os.environ", "environ"):
+            key_expr = node.slice
+        elif isinstance(node, ast.Compare) and len(node.comparators) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and dotted_name(node.comparators[0]) in ("os.environ",
+                                                         "environ"):
+            key_expr = node.left
+        if key_expr is None:
+            return None
+        if isinstance(key_expr, ast.Constant) and isinstance(
+                key_expr.value, str):
+            return key_expr.value
+        if isinstance(key_expr, ast.Name):
+            return pf.str_constants.get(key_expr.id)
+        return None
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        for node in ast.walk(pf.tree):
+            key = self._env_key(pf, node)
+            if key and key.startswith("LO_TPU_"):
+                yield Finding(
+                    self.name, pf.path, node.lineno, node.col_offset,
+                    f"direct read of {key}: LO_TPU_* knobs go through "
+                    "config.py (Settings field or accessor) so every knob "
+                    "is typed, discoverable and documented in one place",
+                    pf.symbol_of(node))
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        cfg = project.by_path(self.CONFIG)
+        if cfg is None:
+            return
+        docs = project.docs_text()
+        seen: Set[str] = set()
+        for m in re.finditer(r"LO_TPU_[A-Z0-9_]+", cfg.source):
+            knob = m.group(0)
+            if knob in seen:
+                continue
+            seen.add(knob)
+            if knob not in docs:
+                line = cfg.source[:m.start()].count("\n") + 1
+                yield Finding(
+                    self.name, cfg.path, line, 0,
+                    f"knob {knob} is defined in config.py but documented "
+                    "nowhere under docs/ (add it to "
+                    "docs/configuration.md)", "")
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+class ThreadLifecycleRule(Rule):
+    name = "thread-lifecycle"
+    description = ("every threading.Thread start site is named and "
+                   "carries a '# thread-lifecycle:' ownership/join/"
+                   "excepthook annotation")
+
+    def applies(self, relpath: str) -> bool:
+        return _in(relpath, PACKAGE)
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname.rsplit(".", 1)[-1] != "Thread" or \
+                    not cname.endswith(("threading.Thread", "Thread")):
+                continue
+            sym = pf.symbol_of(node)
+            kwargs = {kw.arg for kw in node.keywords}
+            if "name" not in kwargs:
+                yield Finding(
+                    self.name, pf.path, node.lineno, node.col_offset,
+                    "threading.Thread() without name=: an unnamed thread's "
+                    "death is unattributable in excepthook reports and "
+                    "stack dumps", sym)
+            if "thread-lifecycle:" not in pf.comment_near(node.lineno):
+                yield Finding(
+                    self.name, pf.path, node.lineno, node.col_offset,
+                    "thread start site lacks a '# thread-lifecycle: "
+                    "owner=<component> exit=<join/daemon/excepthook "
+                    "story>' annotation — the PR 6 dispatcher died "
+                    "silently precisely because nobody owned its exit "
+                    "path", sym)
+
+
+# ---------------------------------------------------------------------------
+# handler-error-map
+# ---------------------------------------------------------------------------
+
+class HandlerErrorMapRule(Rule):
+    name = "handler-error-map"
+    description = ("serving code: no bare except, no silent exception "
+                   "swallowing, and every serving-defined exception "
+                   "class is mapped to a status code in some except "
+                   "clause")
+
+    SCOPE = (f"{PACKAGE}/serving/",)
+
+    def applies(self, relpath: str) -> bool:
+        return _in(relpath, *self.SCOPE)
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            sym = pf.symbol_of(node)
+            if node.type is None:
+                yield Finding(
+                    self.name, pf.path, node.lineno, node.col_offset,
+                    "bare 'except:' catches SystemExit/KeyboardInterrupt "
+                    "and turns any bug into silence — name the exception "
+                    "classes and map them to status codes", sym)
+                continue
+            broad = dotted_name(node.type).rsplit(".", 1)[-1] in (
+                "Exception", "BaseException")
+            swallows = all(isinstance(s, ast.Pass) for s in node.body)
+            if broad and swallows:
+                yield Finding(
+                    self.name, pf.path, node.lineno, node.col_offset,
+                    "'except Exception: pass' black-holes failures (the "
+                    "PR 6 silent-dispatcher-death class): re-raise, map "
+                    "to an HttpError, or at minimum log it", sym)
+
+    @staticmethod
+    def _exception_classes(pf: ParsedFile) -> Iterator[ast.ClassDef]:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {dotted_name(b).rsplit(".", 1)[-1] for b in node.bases}
+            if bases & {"Exception", "RuntimeError", "ValueError",
+                        "KeyError", "OSError", "TimeoutError"} or \
+                    any(b.endswith("Error") for b in bases):
+                yield node
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        serving = [pf for pf in project.files
+                   if self.applies(pf.path)]
+        handled: Set[str] = set()
+        for pf in serving:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.ExceptHandler) and node.type is not None:
+                    types = (node.type.elts
+                             if isinstance(node.type, ast.Tuple)
+                             else [node.type])
+                    for t in types:
+                        handled.add(dotted_name(t).rsplit(".", 1)[-1])
+        for pf in serving:
+            for cls in self._exception_classes(pf):
+                if cls.name not in handled:
+                    yield Finding(
+                        self.name, pf.path, cls.lineno, cls.col_offset,
+                        f"exception class {cls.name} is defined in "
+                        "serving/ but no serving except clause maps it — "
+                        "an unmapped raise surfaces as a raw 500 (the "
+                        "PR 6 BatcherStopped hole)", cls.name)
+
+
+# ---------------------------------------------------------------------------
+# failpoint-coverage
+# ---------------------------------------------------------------------------
+
+class FailpointCoverageRule(Rule):
+    name = "failpoint-coverage"
+    description = ("catalog/ functions performing rename/fsync two-phase "
+                   "commits carry a registered failpoints.fire site; "
+                   "fire() sites use declared constants")
+
+    SCOPE = (f"{PACKAGE}/catalog/",)
+    _COMMIT_CALLS = ("os.rename", "os.replace", "os.fsync")
+
+    def applies(self, relpath: str) -> bool:
+        return _in(relpath, *self.SCOPE)
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        declared = self.declared_sites(pf)
+        seen: Set[int] = set()
+        for fn in pf.functions():
+            if id(fn) in seen:
+                continue
+            commits: List[ast.Call] = []
+            fires: List[ast.Call] = []
+            # Whole lexical subtree: a fire() inside a nested helper
+            # (store._mirror's copy_files) still covers its enclosing
+            # commit function, and nested defs are not re-visited as
+            # standalone functions.
+            for inner in ast.walk(fn):
+                if isinstance(inner,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and inner is not fn:
+                    seen.add(id(inner))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                if cname in self._COMMIT_CALLS:
+                    commits.append(node)
+                elif cname.rsplit(".", 1)[-1] == "fire" and \
+                        "failpoint" in cname:
+                    fires.append(node)
+            if commits and not fires:
+                first = commits[0]
+                sym = pf.symbol_of(fn)
+                yield Finding(
+                    self.name, pf.path, first.lineno, first.col_offset,
+                    f"{call_name(first)}() commit point without a "
+                    "failpoints.fire() site in the same function: the "
+                    "crash sweep (tests/test_failpoints.py) cannot prove "
+                    "recovery at this I/O boundary", sym)
+            for fire in fires:
+                if not fire.args:
+                    continue
+                arg = fire.args[0]
+                if isinstance(arg, ast.Constant):
+                    yield Finding(
+                        self.name, pf.path, fire.lineno, fire.col_offset,
+                        "failpoints.fire() with a string literal: pass a "
+                        "module-level constant bound via "
+                        "failpoints.declare() so the site enters the "
+                        "introspectable registry the sweep enumerates",
+                        pf.symbol_of(fn))
+                elif isinstance(arg, ast.Name) and arg.id not in declared:
+                    yield Finding(
+                        self.name, pf.path, fire.lineno, fire.col_offset,
+                        f"failpoints.fire({arg.id}) but {arg.id} is not "
+                        "bound from failpoints.declare() at module level "
+                        "in this file — undeclared sites never enter the "
+                        "sweep registry", pf.symbol_of(fn))
+
+    @staticmethod
+    def declared_sites(pf: ParsedFile) -> Dict[str, str]:
+        """Module-level ``CONST = failpoints.declare("site")`` bindings:
+        constant name -> site string. Exposed for the runtime
+        cross-check test against failpoints.sites()."""
+        out: Dict[str, str] = {}
+        for stmt in pf.tree.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            cname = call_name(stmt.value)
+            if cname.rsplit(".", 1)[-1] == "declare" and "failpoint" in \
+                    cname and stmt.value.args and isinstance(
+                        stmt.value.args[0], ast.Constant):
+                out[stmt.targets[0].id] = stmt.value.args[0].value
+        return out
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    JitPurityRule(),
+    LockBlockingRule(),
+    EnvDisciplineRule(),
+    ThreadLifecycleRule(),
+    HandlerErrorMapRule(),
+    FailpointCoverageRule(),
+)
+
+
+def rule_names() -> List[str]:
+    return [r.name for r in ALL_RULES]
+
+
+def rules_by_name(names: Optional[Iterable[str]] = None) -> List[Rule]:
+    if names is None:
+        return list(ALL_RULES)
+    wanted = set(names)
+    unknown = wanted - set(rule_names())
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)} "
+                         f"(known: {rule_names()})")
+    return [r for r in ALL_RULES if r.name in wanted]
