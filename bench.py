@@ -43,6 +43,16 @@ headline wall-clock against the sum of the same sweep's per-family fit
 times (which exclude scheduler waits by construction), making the
 pipeline win directly falsifiable.
 
+Tracing (ISSUE 9): the measured sweeps run under an active trace at
+full sampling — what a traced production job pays — and a mirrored,
+interleaved set runs with ``LO_TPU_TRACE_SAMPLE=0`` semantics;
+``tracing_overhead`` records both medians, the percentage delta, and a
+``pass_2pct`` verdict against the < 2% acceptance bar, so an
+instrumentation-cost regression shows up in the trajectory like any
+compute regression. The verdict is recorded rather than asserted: at
+sub-scale smoke sizes rig jitter exceeds 2% in either direction and a
+flapping hard gate would mask real regressions.
+
 Tree families (PR 7): fits route through the fused Pallas
 binned-histogram kernels by default (``tree_kernel`` in the output
 records the active path); their cost model switches with the path
@@ -355,19 +365,54 @@ def main() -> None:
 
     # Median of 3 measured PIPELINED sweeps: the tunneled test chip adds
     # seconds of run-to-run jitter that a single sample would bake into
-    # the record.
+    # the record. Each sweep runs under an active trace at full sampling
+    # — what a traced production job pays — and a second set of 3 runs
+    # with LO_TPU_TRACE_SAMPLE=0 semantics, so the record carries the
+    # measured tracing overhead (ISSUE 9 gate: < 2% on the smoke sweep)
+    # and the trajectory catches an instrumentation-cost regression the
+    # same way it catches a compute one.
+    from learningorchestra_tpu.utils import tracing
+
     cfg.max_concurrent_fits = 2
-    times = []
-    sweeps = []
+
+    def one_sweep(name: str, sample: float):
+        tracing.set_sample(sample)
+        try:
+            t0 = time.time()
+            with tracing.trace(f"bench.sweep.{name}"):
+                reports = mb.build("bench_train", "bench_test",
+                                   f"bench_{name}", classifiers, "label")
+            return time.time() - t0, sweep_doc(reports)
+        finally:
+            tracing.set_sample(None)
+
+    # INTERLEAVED pairs (traced, untraced) so slow machine-state drift
+    # lands on both arms instead of biasing whichever ran last.
+    times, sweeps, off_times, off_sweeps = [], [], [], []
     for i in range(3):
-        t0 = time.time()
-        reports = mb.build("bench_train", "bench_test", f"bench{i}",
-                           classifiers, "label")
-        times.append(time.time() - t0)
-        sweeps.append(sweep_doc(reports))
+        t, s = one_sweep(f"t{i}", 1.0)               # traced (the default)
+        times.append(t)
+        sweeps.append(s)
+        t, s = one_sweep(f"u{i}", 0.0)               # sampling off
+        off_times.append(t)
+        off_sweeps.append(s)
     elapsed = sorted(times)[1]
     median_sweep = sweeps[times.index(elapsed)]
-    for fam in sweeps:
+    untraced_s = sorted(off_times)[1]
+    overhead_pct = (elapsed - untraced_s) / untraced_s * 100
+    tracing_overhead = {
+        "traced_median_s": round(elapsed, 4),
+        "untraced_median_s": round(untraced_s, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        # The ISSUE 9 acceptance verdict, recorded explicitly so the
+        # trajectory (and a reviewer) reads pass/fail without redoing
+        # the arithmetic. Not a hard exit: at sub-scale smoke sizes
+        # rig jitter routinely exceeds 2% in either direction, and a
+        # flapping bench would mask real regressions — the driver/
+        # reviewer judges the flag against the run's scale.
+        "pass_2pct": bool(overhead_pct < 2.0),
+    }
+    for fam in sweeps + off_sweeps:
         check_gates(fam)
     # Per-family fit times exclude scheduler waits by construction
     # (models/builder.py fit_device), so their sum estimates the
@@ -390,6 +435,7 @@ def main() -> None:
             "saved_s": round(overlap_sum - elapsed, 3),
             "serialized_sweep_sum_fit_s": round(serial_sum_fit_s, 3),
         },
+        "tracing_overhead": tracing_overhead,
         "peak_flops": flops_mod.PEAK_FLOPS,
         "peak_bw": flops_mod.PEAK_BW,
         "tree_kernel": tree_kernel,

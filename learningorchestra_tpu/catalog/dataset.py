@@ -46,7 +46,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 import numpy as np
 
 from learningorchestra_tpu.catalog import readpipe
-from learningorchestra_tpu.utils import failpoints
+from learningorchestra_tpu.utils import failpoints, tracing
 
 #: Columns are numpy arrays: numeric dtypes or ``object`` for strings/mixed.
 Columns = Dict[str, np.ndarray]
@@ -596,6 +596,7 @@ class Dataset:
         idiom at chunk granularity, projection.py:78-123)."""
         if not records:
             return
+        t0 = time.monotonic()
         with open(self._journal_path, "a") as f:
             for rec in records:
                 f.write(json.dumps(rec) + "\n")
@@ -606,6 +607,11 @@ class Dataset:
             failpoints.fire(FP_JOURNAL_MID_APPEND, path=self._journal_path)
             os.fsync(f.fileno())
         self._journal_records += len(records)
+        # The durability tax of a traced ingest/build, attributed: one
+        # span per journal commit (append + fsync). No-op untraced.
+        tracing.record_span("journal.commit", time.monotonic() - t0,
+                            attrs={"records": len(records),
+                                   "dataset": self.metadata.name})
 
     def _flush_chunk_locked(self, chunk: _Chunk) -> None:
         """Write + journal-commit one chunk (eviction path)."""
@@ -1348,14 +1354,18 @@ def _pipelined_materialize(chunks: List["_Chunk"],
     position instead of hanging the stream. On close/abandonment the
     window is cancelled and in-flight reads are waited out, so callers
     can safely drop reader registrations (chunk-file GC) afterwards."""
-    if depth <= 0 or len(chunks) <= 1:
-        for c in chunks:
-            yield c, c.materialize(fields)
-        return
-    pool = readpipe.pool()
+    t0 = time.monotonic()
+    hits0, misses0 = readpipe.cache_probe()
+    produced = 0
     window: deque = deque()          # (chunk, future), submission order
-    nxt = 0
     try:
+        if depth <= 0 or len(chunks) <= 1:
+            for c in chunks:
+                yield c, c.materialize(fields)
+                produced += 1
+            return
+        pool = readpipe.pool()
+        nxt = 0
         while nxt < len(chunks) and len(window) < depth:
             c = chunks[nxt]
             nxt += 1
@@ -1375,6 +1385,7 @@ def _pipelined_materialize(chunks: List["_Chunk"],
                 nxt += 1
                 window.append((c2, pool.submit(c2.materialize, fields)))
             yield c, cols
+            produced += 1
     finally:
         for _c, fut in window:
             fut.cancel()
@@ -1384,6 +1395,17 @@ def _pipelined_materialize(chunks: List["_Chunk"],
                     fut.result()
                 except BaseException:  # noqa: BLE001 — result discarded
                     pass
+        # One span per scan (not per chunk), covering first-next →
+        # exhaustion/close on the consumer thread — the read-pipeline
+        # leg of a traced job's time. No-op without an ambient trace.
+        # Cache traffic is a global-counter delta: exact for a lone
+        # scan, approximate while scans overlap.
+        hits1, misses1 = readpipe.cache_probe()
+        tracing.record_span(
+            "readpipe.materialize", time.monotonic() - t0,
+            attrs={"chunks": produced, "snapshot_chunks": len(chunks),
+                   "depth": depth, "cache_hits": hits1 - hits0,
+                   "cache_misses": misses1 - misses0})
 
 
 class SnapshotReader:

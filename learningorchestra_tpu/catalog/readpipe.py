@@ -111,6 +111,16 @@ def bump(key: str, by: int = 1) -> None:
         _counters[key] += by
 
 
+def cache_probe() -> Tuple[int, int]:
+    """Current (cache_hits, cache_misses) totals — scan instrumentation
+    (the ``readpipe.materialize`` span) diffs two probes to attribute a
+    scan's cache traffic. Counters are process-global, so the delta is
+    exact for the common single-scan case and approximate while scans
+    overlap (documented on the span)."""
+    with _lock:
+        return _counters["cache_hits"], _counters["cache_misses"]
+
+
 def snapshot() -> Dict[str, Any]:
     """Counter snapshot for ``GET /metrics`` (``read_pipeline`` section)."""
     with _lock:

@@ -154,6 +154,27 @@ class Context:
     def delete(self, path: str, **kw):
         return self.request("DELETE", path, **kw)
 
+    # -- tracing (GET /traces, GET /trace/{id}) ------------------------------
+
+    def traces(self, route: Optional[str] = None,
+               kind: Optional[str] = None,
+               min_ms: Optional[float] = None,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Recent traces from the server's ring buffer, newest first —
+        filterable by route substring (HTTP traces), job kind, and
+        minimum root-span duration (ms)."""
+        params = {k: v for k, v in (("route", route), ("kind", kind),
+                                    ("min_ms", min_ms), ("limit", limit))
+                  if v is not None}
+        return ResponseTreat.treatment(self.get("/traces", params=params))
+
+    def trace(self, trace_id: str) -> Dict[str, Any]:
+        """One trace's span tree (``GET /trace/{id}``). Every response
+        carries its trace id in ``X-Request-Id`` — and every error this
+        client raises quotes it — so the id to pass here is always at
+        hand."""
+        return ResponseTreat.treatment(self.get(f"/trace/{trace_id}"))
+
 
 class ResponseTreat:
     """Uniform response handling (reference __init__.py:35-52)."""
@@ -162,8 +183,13 @@ class ResponseTreat:
     def treatment(response, pretty: bool = False):
         payload = response.json()
         if response.status_code >= 400:
+            # Quote the server's X-Request-Id: the trace id of the failed
+            # call, resolvable via GET /trace/{id} and greppable in the
+            # server's structured logs.
+            rid = response.headers.get("X-Request-Id")
             raise RuntimeError(
-                f"HTTP {response.status_code}: {payload.get('result')}")
+                f"HTTP {response.status_code}: {payload.get('result')}"
+                + (f" [request-id {rid}]" if rid else ""))
         return json.dumps(payload, indent=2) if pretty else payload
 
 
@@ -353,6 +379,12 @@ class Observability(_ServiceClient):
 
     def cluster(self) -> Dict:
         return ResponseTreat.treatment(self.context.get("/cluster"))
+
+    def traces(self, **filters) -> List[Dict]:
+        return self.context.traces(**filters)
+
+    def trace(self, trace_id: str) -> Dict:
+        return self.context.trace(trace_id)
 
 
 class Model(_ServiceClient):

@@ -275,6 +275,29 @@ class Settings:
     profile_dir: str = field(
         default_factory=lambda: _env("LO_TPU_PROFILE_DIR", "")
     )
+    #: Capacity (spans) of the in-process trace ring buffer
+    #: (utils/tracing.py). Old spans evict FIFO past this, so a long-lived
+    #: server holds a bounded window of recent traces. 0 disables span
+    #: retention entirely (trace ids still mint and propagate).
+    trace_buffer_spans: int = field(
+        default_factory=lambda: _env("LO_TPU_TRACE_BUFFER_SPANS", 4096)
+    )
+    #: Probability (0.0-1.0) that a new trace records spans. 1.0 traces
+    #: every request/job; 0.0 disables recording (ids still propagate,
+    #: which is what the bench's overhead A/B toggles).
+    trace_sample: float = field(
+        default_factory=lambda: _env("LO_TPU_TRACE_SAMPLE", 1.0)
+    )
+    #: Log line format for the structured logger (utils/structlog.py):
+    #: "text" (human-readable, trace ids appended) or "json" (one JSON
+    #: doc per line, trace/span ids as fields).
+    log_format: str = field(
+        default_factory=lambda: _env("LO_TPU_LOG_FORMAT", "text")
+    )
+    #: Log level for the framework's ``lo_tpu`` logger tree.
+    log_level: str = field(
+        default_factory=lambda: _env("LO_TPU_LOG_LEVEL", "INFO")
+    )
 
     def replace(self, **kw) -> "Settings":
         new = Settings()

@@ -24,6 +24,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from learningorchestra_tpu.utils import tracing
 from learningorchestra_tpu.utils.profiling import op_timer
 
 #: The currently-running job's record: its body (and anything it calls
@@ -93,6 +94,10 @@ class JobRecord:
     error: Optional[str] = None
     started_at: float = field(default_factory=time.time)
     finished_at: Optional[float] = None
+    #: The job's trace id: the submitting HTTP request's trace when one
+    #: was ambient at submit (one trace spans accept → job completion),
+    #: else freshly minted — either way, ``GET /trace/{id}`` resolves it.
+    trace_id: Optional[str] = None
     #: Profiling metadata the job body recorded (record_job_profile):
     #: streamed-fit pass counts, per-family device_s, ...
     profile: Dict[str, Any] = field(default_factory=dict)
@@ -103,6 +108,7 @@ class JobRecord:
             "status": self.status, "error": self.error,
             "started_at": self.started_at, "finished_at": self.finished_at,
             "duration": (self.finished_at or time.time()) - self.started_at,
+            "trace_id": self.trace_id,
         }
         if self.profile:
             doc["profile"] = dict(self.profile)
@@ -136,10 +142,16 @@ class JobManager:
         """
         datasets: List[str] = ([dataset] if isinstance(dataset, str)
                                else list(dataset))
+        # Capture the submitting thread's trace position NOW: the pool
+        # thread running the job has no ambient context of its own, and
+        # the HTTP request whose handler submitted us will be long gone.
+        parent_ctx = tracing.current()
         with self._lock:
             self._seq += 1
             rec = JobRecord(job_id=f"{kind}-{self._seq}",
-                            dataset=",".join(datasets), kind=kind)
+                            dataset=",".join(datasets), kind=kind,
+                            trace_id=(parent_ctx.trace_id if parent_ctx
+                                      else tracing.new_id()))
             self._jobs[rec.job_id] = rec
             if len(self._jobs) > self.MAX_RECORDS:
                 for jid, r in list(self._jobs.items()):
@@ -163,7 +175,21 @@ class JobManager:
 
             token = _job_record.set(rec)
             try:
-                fn()
+                # The job's root span: joins the submitting request's
+                # trace when one was ambient, else roots a new trace
+                # under rec.trace_id. Everything the job body records
+                # (design.build, fit.*, journal.commit, worker-process
+                # spans over the SPMD channel) nests under it; a raise
+                # marks the span status=error before the handling below.
+                from learningorchestra_tpu import config
+
+                with tracing.job_trace(
+                        f"job.{kind}", trace_id=rec.trace_id,
+                        parent=parent_ctx,
+                        attrs={"kind": kind, "dataset": rec.dataset,
+                               "job_id": rec.job_id,
+                               "mesh_epoch": config.mesh_epoch()}):
+                    fn()
                 rec.status = "done"
             except PodDegraded as exc:
                 # A job refused (or interrupted) because the pod is

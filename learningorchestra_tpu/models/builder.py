@@ -58,6 +58,7 @@ from learningorchestra_tpu.models.registry import get_trainer
 from learningorchestra_tpu.ops import preprocess
 from learningorchestra_tpu.parallel import spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
+from learningorchestra_tpu.utils import tracing
 from learningorchestra_tpu.utils.profiling import (
     device_span, device_trace, op_timer, timed)
 
@@ -114,6 +115,7 @@ class ModelBuilder:
 
         pp_meta = None
         streamed = False
+        design_t0 = time.monotonic()
         if preprocessor_code is not None:
             if multi:
                 raise PermissionError(
@@ -173,6 +175,13 @@ class ModelBuilder:
             # datasets when the fitted model is re-served (persistence.py).
             pp_meta = {"steps": list(steps), "state": state,
                        "feature_fields": feature_fields, "label": label}
+        # One span covers whichever design-matrix path ran (exec /
+        # streamed / memoized-resident): explicit duration, no reindent
+        # of the three-way branch above.
+        tracing.record_span(
+            "design.build", time.monotonic() - design_t0,
+            attrs={"train": train, "test": test, "streamed": streamed,
+                   "rows": int(len(X_train))})
         if y_train is None:
             raise ValueError(f"label field {label!r} not in {train!r}")
         num_classes = int(max(int(y_train.max()) + 1,
@@ -216,7 +225,8 @@ class ModelBuilder:
             cost a serialized sweep would pay). Returns (probs,
             device_s)."""
             probs, device_s = device_span(
-                lambda: model.predict_proba(self.runtime, X_test))
+                lambda: model.predict_proba(self.runtime, X_test),
+                name=f"fit.{c}.device")
             op_timer.record(f"fit.{c}", pre_s + device_s)
             op_timer.record(f"fit.{c}.device", device_s)
             return probs, device_s
@@ -313,23 +323,40 @@ class ModelBuilder:
         n_dev = int(np.prod(list(self.runtime.mesh.shape.values())))
         gate = threading.BoundedSemaphore(
             max(1, int(self.cfg.max_concurrent_fits)) if n_dev == 1 else 1)
+        # Pool threads carry no ambient trace — re-attach the build's
+        # context so each family's spans nest under the job/request span
+        # (the Gantt view of the PR-3 overlap: fit.<c> spans overlap in
+        # wall time; their host_prep/device/finish children show which
+        # phase overlapped which).
+        parent_ctx = tracing.current()
 
         def fit_guarded(c: str) -> FitReport:
-            try:
-                extra, prep_s = prep_fit(c)        # outside the gate
-                with gate:                         # device phase
-                    with Timer() as td:
-                        model = dispatch_fit(c, extra)
-                    pre_s = prep_s + td.elapsed
-                    probs, device_s = collect_fit(c, model, pre_s)
-                # fit_time = prep + dispatch + device spans, no scheduler
-                # waits: the per-family sum estimates the serialized
-                # sweep, and the gap to build wall-clock IS the overlap
-                # won.
-                return finish_host(c, model, probs, pre_s + device_s,
-                                   device_s)
-            except Exception as exc:  # noqa: BLE001 — per-model boundary
-                return fail_report(c, exc)
+            with tracing.attach(parent_ctx):
+                try:
+                    # The except sits OUTSIDE the span: a failing family
+                    # must escape it so the fit.<c> span records
+                    # status=error — the trace view and the report may
+                    # never disagree about whether a family succeeded.
+                    with tracing.span(f"fit.{c}", family=c):
+                        extra, prep_s = prep_fit(c)   # outside the gate
+                        tracing.record_span(f"fit.{c}.host_prep", prep_s)
+                        with gate:                    # device phase
+                            with Timer() as td:
+                                model = dispatch_fit(c, extra)
+                            pre_s = prep_s + td.elapsed
+                            probs, device_s = collect_fit(c, model, pre_s)
+                        # fit_time = prep + dispatch + device spans, no
+                        # scheduler waits: the per-family sum estimates
+                        # the serialized sweep, and the gap to build
+                        # wall-clock IS the overlap won.
+                        with Timer() as tf:
+                            report = finish_host(c, model, probs,
+                                                 pre_s + device_s,
+                                                 device_s)
+                        tracing.record_span(f"fit.{c}.finish", tf.elapsed)
+                        return report
+                except Exception as exc:  # noqa: BLE001 — per-model bound
+                    return fail_report(c, exc)
 
         with device_trace(self.cfg), ThreadPoolExecutor(
                 max_workers=max(len(classifiers), 1)) as pool:
@@ -380,6 +407,7 @@ class ModelBuilder:
                 t0 = time.time()
                 try:
                     extra, prep_s = prep_fit(c)
+                    tracing.record_span(f"fit.{c}.host_prep", prep_s)
                     model = dispatch_fit(c, extra)
                     # No-op on TPU (stream order keeps back-to-back
                     # programs aligned); fences the CPU test rig, whose
@@ -413,7 +441,9 @@ class ModelBuilder:
                 reports.append(fail_report(c, res))
                 continue
             try:
-                reports.append(finish_host(c, *res))
+                with Timer() as tf:
+                    reports.append(finish_host(c, *res))
+                tracing.record_span(f"fit.{c}.finish", tf.elapsed)
             except Exception as exc:  # noqa: BLE001 — per-model boundary
                 reports.append(fail_report(c, exc))
         return reports

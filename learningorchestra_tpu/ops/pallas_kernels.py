@@ -457,9 +457,9 @@ def tree_kernels_supported() -> bool:
         h.block_until_ready()
         ok = True
     except Exception as e:  # pragma: no cover - backend-specific
-        import logging
+        from learningorchestra_tpu.utils.structlog import get_logger
 
-        logging.getLogger(__name__).warning(
+        get_logger("pallas").warning(
             "tree Pallas kernels unavailable on backend %r (%s); "
             "falling back to the XLA contraction path", backend, e)
         ok = False

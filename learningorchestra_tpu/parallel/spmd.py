@@ -33,17 +33,16 @@ from __future__ import annotations
 
 import contextlib
 import json
-import logging
-import os
 import socket
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from learningorchestra_tpu import config as _config
-from learningorchestra_tpu.utils import failpoints
+from learningorchestra_tpu.utils import failpoints, tracing
+from learningorchestra_tpu.utils.structlog import get_logger
 
-log = logging.getLogger("lo_tpu.spmd")
+log = get_logger("spmd")
 
 #: Deterministic fault-injection site: process 0, every worker ready,
 #: about to release them with 'go' — the dispatch-side crash window the
@@ -266,6 +265,14 @@ class _JobChannel:
                 ack = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            if ack.get("op") == "spans":
+                # A worker's span shipment from an earlier job that the
+                # post-job drain timed out on: merge it late rather than
+                # dropping it — and never mistake it for this round's
+                # ack (it carries the OLD round id, but defense in
+                # depth beats a coincidence).
+                tracing.ingest(ack.get("spans") or [])
+                continue
             if ack.get("round") == rnd:
                 return "ok", ack
             # stale ack from an earlier aborted round — discard
@@ -323,6 +330,37 @@ class _JobChannel:
     def broadcast(self, msg: Dict[str, Any]) -> None:
         """Fire-and-forget control message (shutdown) — no ack round."""
         self._sendall(self._live(), msg)
+
+    def drain_spans(self, rnd: int, timeout_s: float = 5.0) -> int:
+        """Collect each worker's span shipment for round ``rnd`` (sent
+        unprompted after its device ops finish) and merge it into this
+        process's trace buffer. Runs inside the dispatch guard right
+        after the coordinator's own device ops complete — the workers
+        ran the same collective program, so their shipments are
+        imminent; the timeout bounds a wedged/slow worker (its spans
+        then merge at the next round's ack read instead). Returns how
+        many workers' spans merged."""
+        merged = 0
+        for conn in self._live():
+            deadline = time.time() + timeout_s
+            while True:
+                status, line = conn.recv_line(
+                    max(0.1, deadline - time.time()))
+                if status != "ok":
+                    break                      # timeout/EOF: catch up later
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if msg.get("op") == "spans":
+                    tracing.ingest(msg.get("spans") or [])
+                    if msg.get("round") == rnd:
+                        merged += 1
+                        break
+                # stale ack from an aborted round — discard, keep reading
+                if time.time() >= deadline:
+                    break
+        return merged
 
     def monitor_workers(self, stop: threading.Event, on_death) -> None:
         """Poll worker sockets for EOF (MSG_PEEK — never consumes ack
@@ -453,11 +491,17 @@ def dispatch(spec: Dict[str, Any]) -> None:
     execute exactly the device-op sequence `run_job` executes for this
     spec. The spec is stamped with the pod's mesh epoch — workers nack
     specs from a different incarnation (defense in depth behind the
-    connection handshake)."""
+    connection handshake) — and with the coordinator's ambient trace
+    context, so worker-process spans join the SAME trace and merge back
+    on the coordinator (``GET /trace/{id}`` covers the whole pod)."""
     if not is_multiprocess():
         return
     require_pod_health()
-    _get_channel().dispatch(dict(spec, epoch=mesh_epoch()))
+    stamped = dict(spec, epoch=mesh_epoch())
+    wire = tracing.to_wire()
+    if wire is not None:
+        stamped["trace"] = wire
+    _get_channel().dispatch(stamped)
 
 
 @contextlib.contextmanager
@@ -515,6 +559,17 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
         finally:
             stop.set()
             monitor.join(timeout=2.0)
+        # Merge the workers' spans for this job (they ship them
+        # unprompted once their device ops finish). Runs only when the
+        # device ops completed (an aborted round's workers never ran, so
+        # waiting on their shipment would just burn the timeout), only
+        # when this job is actually traced, and never on a degraded pod.
+        ctx = tracing.current()
+        if ctx is not None and ctx.sampled and pod_error() is None:
+            channel = _get_channel()
+            with channel._lock:
+                rnd = channel._round
+            channel.drain_spans(rnd)
         # The compute may have completed on this process even though a
         # worker died (death after its last collective): the outputs were
         # already flagged failed, so surface the degradation to the caller
@@ -773,6 +828,10 @@ def worker_loop(store, runtime) -> str:
     import jax
 
     epoch = mesh_epoch()
+    # Spans this process records carry its pod rank, so the merged
+    # coordinator view can attribute per-process time (the 2-process
+    # propagation test pins exactly this).
+    tracing.set_process(jax.process_index())
     log.info("worker %d/%d entering SPMD loop (epoch %d)",
              jax.process_index(), jax.process_count(), epoch)
     sock = _connect_to_controller()
@@ -820,6 +879,10 @@ def worker_loop(store, runtime) -> str:
             continue  # stray control line from an aborted round
         prepper = _PREPPERS.get(op)
         device_ops = None
+        # The coordinator's trace context rides the spec: this worker's
+        # prep + device spans join the SAME trace and ship back after
+        # the job, so GET /trace/{id} on the coordinator covers the pod.
+        wctx = tracing.from_wire(spec.get("trace"))
         if prepper is None:
             ok = reply({"status": "fail", "round": rnd,
                         "error": f"unknown job op: {op!r}"})
@@ -831,7 +894,9 @@ def worker_loop(store, runtime) -> str:
                                  f"{spec.get('epoch')} != worker {epoch}"})
         else:
             try:
-                device_ops = prepper(store, runtime, spec)
+                with tracing.attach(wctx), tracing.span("worker.prep",
+                                                        op=op):
+                    device_ops = prepper(store, runtime, spec)
                 ok = reply({"status": "ready", "round": rnd})
             except Exception as exc:  # noqa: BLE001 — nack, keep loop alive
                 log.exception("worker prep for %r failed", op)
@@ -850,10 +915,19 @@ def worker_loop(store, runtime) -> str:
         verdict = json.loads(line).get("op")
         if verdict == "go" and device_ops is not None:
             try:
-                with mesh_scope():
+                with tracing.attach(wctx), \
+                        tracing.span("dispatch.device", op=op), \
+                        mesh_scope():
                     device_ops()
             except Exception:  # noqa: BLE001 — keep the loop alive
                 log.exception("worker device ops for %r failed", op)
+            if wctx is not None and wctx.sampled:
+                # Ship this job's spans to the coordinator (it drains
+                # them right after its own device ops; a missed drain
+                # merges at the next round's ack read). Failure to send
+                # = controller gone, caught at the next recv.
+                reply({"op": "spans", "round": rnd,
+                       "spans": tracing.pop_spans(wctx.trace_id)})
         elif verdict == "shutdown":
             return "shutdown"
 

@@ -11,9 +11,13 @@ import argparse
 from learningorchestra_tpu.config import settings
 from learningorchestra_tpu.parallel import distributed
 from learningorchestra_tpu.serving.app import App
+from learningorchestra_tpu.utils import structlog
+
+log = structlog.get_logger("serving.main")
 
 
 def main() -> None:
+    structlog.configure()
     parser = argparse.ArgumentParser(description="learningorchestra_tpu server")
     parser.add_argument("--host", default=settings.host)
     parser.add_argument("--port", type=int, default=settings.port)
@@ -39,11 +43,11 @@ def main() -> None:
         from learningorchestra_tpu.parallel import spmd
         from learningorchestra_tpu.parallel.mesh import MeshRuntime
 
-        print(f"learningorchestra_tpu worker "
-              f"{jax.process_index()}/{jax.process_count()} "
-              f"(devices: {distributed.process_info()['devices']}, "
-              f"mesh epoch {spmd.mesh_epoch()})",
-              flush=True)
+        log.info("learningorchestra_tpu worker %d/%d (devices: %s, "
+                 "mesh epoch %d)", jax.process_index(),
+                 jax.process_count(),
+                 distributed.process_info()["devices"],
+                 spmd.mesh_epoch())
         reason = spmd.worker_loop(DatasetStore(settings),
                                   MeshRuntime(settings))
         if reason != "shutdown":
@@ -61,8 +65,8 @@ def main() -> None:
 
     spmd.ensure_channel()  # workers connect at boot; listener must exist
     app = App(settings, recover=not args.no_recover)
-    print(f"learningorchestra_tpu serving on {args.host}:{args.port} "
-          f"(devices: {distributed.process_info()['devices']})", flush=True)
+    log.info("learningorchestra_tpu serving on %s:%d (devices: %s)",
+             args.host, args.port, distributed.process_info()["devices"])
     try:
         app.serve()
     finally:

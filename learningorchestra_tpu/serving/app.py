@@ -18,7 +18,6 @@ the upgrade that failed jobs set ``error`` and still flip ``finished``.
 from __future__ import annotations
 
 import json
-import logging
 from typing import Optional
 
 from learningorchestra_tpu.catalog.dataset import ChunkCorrupt
@@ -37,11 +36,14 @@ from learningorchestra_tpu.parallel.mesh import MeshRuntime
 from learningorchestra_tpu.serving.batcher import (
     BatcherStopped, PredictBatcher, PredictTimeout, QueueFull)
 from learningorchestra_tpu.serving.http import (
-    FileResponse, HtmlResponse, HttpError, IdempotencyCache, Router, Server)
+    FileResponse, HtmlResponse, HttpError, IdempotencyCache, Router,
+    Server, TextResponse)
+from learningorchestra_tpu.utils import tracing
+from learningorchestra_tpu.utils.structlog import get_logger
 from learningorchestra_tpu.viz.service import (
     ImageExists, ImageNotFound, ImageService, create_embedding_image)
 
-log = logging.getLogger("lo_tpu.serving")
+log = get_logger("serving")
 
 
 class App:
@@ -407,20 +409,53 @@ class App:
                 serving=app.predictor.snapshot()))
 
         @self._route("GET", "/metrics")
-        def metrics(_req):
-            from learningorchestra_tpu.catalog import readpipe
-            from learningorchestra_tpu.utils.profiling import op_timer
+        def metrics(req):
+            doc = app._metrics_doc()
+            if req.q("format") == "prometheus":
+                from learningorchestra_tpu.utils import prometheus
 
-            recs = app.jobs.records()
-            by_status: dict = {}
-            for r in recs:
-                by_status[r["status"]] = by_status.get(r["status"], 0) + 1
-            return 200, {"ops": op_timer.snapshot(),
-                         "jobs": by_status,
-                         "integrity": app.store.integrity_snapshot(),
-                         "read_pipeline": readpipe.snapshot(),
-                         "serving": app.predictor.snapshot(),
-                         "profile_dir": app.cfg.profile_dir or None}
+                # Same registry snapshot, second format: the exposition
+                # text is rendered from the identical doc the JSON view
+                # serves, so the two can never disagree.
+                return 200, TextResponse(prometheus.render(doc))
+            return 200, doc
+
+        # ---- tracing (the request/job-scoped view /metrics can't give:
+        # "where did THIS request spend its time")
+        @self._route("GET", "/traces")
+        def traces(req):
+            return 200, tracing.recent_traces(
+                route=req.q("route"),
+                kind=req.q("kind"),
+                min_ms=req.q("min_ms", cast=float),
+                limit=req.q("limit", 50, int))
+
+        @self._route("GET", "/trace/{trace_id}")
+        def trace_by_id(req):
+            tree = tracing.trace_tree(req.params["trace_id"])
+            if tree is None:
+                raise HttpError(
+                    404, f"no spans for trace {req.params['trace_id']} "
+                    "(expired from the ring buffer, unsampled, or never "
+                    "existed)")
+            return 200, tree
+
+    def _metrics_doc(self) -> dict:
+        """The one metrics registry snapshot both /metrics formats render
+        (JSON as-is; ?format=prometheus through utils/prometheus)."""
+        from learningorchestra_tpu.catalog import readpipe
+        from learningorchestra_tpu.utils.profiling import op_timer
+
+        by_status: dict = {}
+        for r in self.jobs.records():
+            by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+        return {"ops": op_timer.snapshot(),
+                "jobs": by_status,
+                "integrity": self.store.integrity_snapshot(),
+                "read_pipeline": readpipe.snapshot(),
+                "serving": self.predictor.snapshot(),
+                "tracing": tracing.counters_snapshot(),
+                "profile_dir": self.cfg.profile_dir or None}
 
     def _register_images(self, method: str) -> None:
         app = self

@@ -21,8 +21,18 @@ client-side pacing instead of collapse.
 Instrumentation feeds the ``serving`` section of ``/metrics`` and the
 status page: per-model and aggregate request/row/batch counts, rejected
 and failed counts, mean batch occupancy (rows per dispatch — the
-batching win, directly), live queue depth, p50/p99 end-to-end latency
-over a sliding window, and QPS over the last ~30 s.
+batching win, directly), live queue depth, a log-bucketed end-to-end
+latency histogram (p50/p99 are estimated from its buckets — exact over
+the model's whole life, and the same series Prometheus scrapes; the old
+rolling-sample percentiles forgot everything past 2048 requests), and
+QPS over the last ~30 s.
+
+Tracing: each traced request's trace context rides its queue entry, so
+the dispatcher can attribute — per request — ``queue.wait`` (enqueue →
+taken), and link one ``batch.coalesce`` span per coalesced dispatch as
+the parent of every co-batched request's ``dispatch.device`` span:
+queue wait, device time, and scatter tail finally separate per request
+instead of blurring into one p99.
 """
 
 from __future__ import annotations
@@ -37,9 +47,10 @@ import numpy as np
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.models.aot import AotCache, design_from_rows
 from learningorchestra_tpu.models.persistence import ModelRegistry
+from learningorchestra_tpu.utils import profiling, tracing
 
-#: Latency samples kept per model for the percentile window.
-_LATENCY_WINDOW = 2048
+#: Completion timestamps kept per model for the QPS window.
+_QPS_SAMPLES = 2048
 #: Seconds of request-completion history the QPS figure covers.
 _QPS_WINDOW_S = 30.0
 
@@ -69,22 +80,36 @@ class BatcherStopped(Exception):
 
 class _Pending:
     """One enqueued request: its design rows, the AOT entry its design
-    was built against, and the slot the dispatcher scatters the result
-    (or error) into."""
+    was built against, the submitting request's trace context (so the
+    dispatcher thread can record spans INTO that request's trace), and
+    the slot the dispatcher scatters the result (or error) into."""
 
-    __slots__ = ("X", "entry", "done", "probs", "error", "t_enqueue")
+    __slots__ = ("X", "entry", "ctx", "done", "probs", "error",
+                 "t_enqueue", "t_taken")
 
     def __init__(self, X: np.ndarray, entry: Any):
         self.X = X
         self.entry = entry
+        self.ctx = tracing.current()
         self.done = threading.Event()
         self.probs: Optional[np.ndarray] = None
         self.error: Optional[Exception] = None
         self.t_enqueue = time.monotonic()
+        self.t_taken: Optional[float] = None
 
 
 class _Stats:
-    """Lock-protected counters + sliding latency window for one model."""
+    """Lock-protected counters + latency histogram for one model.
+
+    Latency lives in log-bucketed histograms (the shared
+    ``profiling.BUCKETS_S`` ladder): a LIFETIME histogram — the exact
+    cumulative series Prometheus scrapes (scrapers window it themselves
+    with ``rate()``) — plus a two-epoch rotating window (epochs of
+    ``_QPS_WINDOW_S``) that the JSON view's ``p50_ms``/``p99_ms``
+    estimate from, so a latency regression on a long-lived server moves
+    the operator-facing percentiles within seconds instead of drowning
+    in millions of historical observations. QPS keeps a timestamp ring
+    (a rate needs exact recency)."""
 
     def __init__(self):
         self.requests = 0
@@ -94,28 +119,61 @@ class _Stats:
         self.rejected = 0
         self.timeouts = 0
         self.errors = 0
-        #: (completion monotonic time, latency seconds) ring.
-        self.latencies: collections.deque = collections.deque(
-            maxlen=_LATENCY_WINDOW)
+        self.lat_buckets = profiling.new_histogram()
+        self.lat_sum_s = 0.0
+        #: Two-epoch rotating window for recency-sensitive percentiles:
+        #: p50/p99 read prev+current, covering the last 1-2 epochs.
+        self._lat_recent = profiling.new_histogram()
+        self._lat_prev = profiling.new_histogram()
+        self._rotated_at = time.monotonic()
+        #: Completion monotonic timestamps ring (QPS only).
+        self.completions: collections.deque = collections.deque(
+            maxlen=_QPS_SAMPLES)
+
+    def _maybe_rotate(self, now: float) -> None:
+        gap = now - self._rotated_at
+        if gap > 2 * _QPS_WINDOW_S:
+            # Idle longer than both epochs: everything in the window is
+            # stale — clear it rather than promoting a minutes-old epoch
+            # into "recent" (percentiles then fall back to the lifetime
+            # shape until fresh traffic refills the window).
+            self._lat_prev = profiling.new_histogram()
+            self._lat_recent = profiling.new_histogram()
+            self._rotated_at = now
+        elif gap > _QPS_WINDOW_S:
+            self._lat_prev = self._lat_recent
+            self._lat_recent = profiling.new_histogram()
+            self._rotated_at = now
+
+    def observe(self, latency_s: float) -> None:
+        """Record one completed request's latency (caller holds the
+        stats lock)."""
+        now = time.monotonic()
+        self._maybe_rotate(now)
+        profiling.observe(self.lat_buckets, latency_s)
+        profiling.observe(self._lat_recent, latency_s)
+        self.lat_sum_s += latency_s
+        self.completions.append(now)
 
     def snapshot(self, queue_rows: int) -> Dict[str, Any]:
         now = time.monotonic()
-        recent = [(t, s) for t, s in self.latencies
-                  if now - t <= _QPS_WINDOW_S]
-        lats = sorted(s for _, s in recent) or sorted(
-            s for _, s in self.latencies)
+        self._maybe_rotate(now)
+        recent = [t for t in self.completions if now - t <= _QPS_WINDOW_S]
         # Divide by the full window once it has rolled over; before that
         # (young server) by the observed span, floored so one lone
         # sample can't read as thousands of QPS.
-        span = (_QPS_WINDOW_S if len(recent) < len(self.latencies)
-                else max(now - recent[0][0], 1.0) if recent else None)
+        span = (_QPS_WINDOW_S if len(recent) < len(self.completions)
+                else max(now - recent[0], 1.0) if recent else None)
         qps = (len(recent) / span) if recent and span else 0.0
+        # Recent-window percentiles (prev + current epoch); an idle
+        # model falls back to its lifetime shape rather than reading
+        # None the moment traffic pauses.
+        window = [a + b for a, b in zip(self._lat_prev, self._lat_recent)]
+        source = window if sum(window) else self.lat_buckets
 
-        def pct(p: float) -> Optional[float]:
-            if not lats:
-                return None
-            return round(lats[min(int(p * len(lats)), len(lats) - 1)] * 1e3,
-                         3)
+        def pct(q: float) -> Optional[float]:
+            est = profiling.quantile_from_buckets(source, q)
+            return None if est is None else round(est * 1e3, 3)
 
         return {
             "requests": self.requests,
@@ -130,6 +188,8 @@ class _Stats:
             "qps": round(qps, 3),
             "p50_ms": pct(0.50),
             "p99_ms": pct(0.99),
+            "latency": {"buckets": list(self.lat_buckets),
+                        "sum_s": round(self.lat_sum_s, 6)},
         }
 
 
@@ -199,7 +259,7 @@ class ModelBatcher:
         with _stats_lock:
             self.stats.requests += 1
             self.stats.rows += n
-            self.stats.latencies.append((time.monotonic(), lat))
+            self.stats.observe(lat)
         return pending.probs
 
     def queue_rows(self) -> int:
@@ -245,6 +305,9 @@ class ModelBatcher:
                 batch.append(self._queue.popleft())
                 rows = len(batch[0].X)
             self._queue_rows -= rows
+            t_taken = time.monotonic()
+            for p in batch:
+                p.t_taken = t_taken
             return batch
 
     def _loop(self) -> None:
@@ -258,6 +321,15 @@ class ModelBatcher:
                 if self._stopped:
                     return
                 continue
+            # Per-request queue-wait attribution: enqueue → taken by the
+            # dispatcher, recorded into EACH request's own trace (the
+            # p99 blur the rolling-sample window could never decompose).
+            for p in batch:
+                if p.ctx is not None and p.ctx.sampled:
+                    tracing.record_span(
+                        "queue.wait", (p.t_taken or p.t_enqueue)
+                        - p.t_enqueue, ctx=p.ctx,
+                        attrs={"model": self.name, "rows": len(p.X)})
             # Group by the entry captured at enqueue: requests that
             # straddle a hot-swap evaluate through the version their
             # design matrix was built for (mixing would run old-state
@@ -270,9 +342,11 @@ class ModelBatcher:
                 groups.setdefault(id(p.entry), []).append(p)
             for grp in groups.values():
                 try:
+                    t0 = time.monotonic()
                     X = (grp[0].X if len(grp) == 1
                          else np.concatenate([p.X for p in grp], axis=0))
                     probs = grp[0].entry.predict(X)
+                    t_device = time.monotonic() - t0
                     off = 0
                     for p in grp:
                         p.probs = probs[off:off + len(p.X)]
@@ -280,6 +354,28 @@ class ModelBatcher:
                     with _stats_lock:
                         self.stats.batches += 1
                         self.stats.batched_rows += off
+                    # One batch.coalesce span per coalesced dispatch
+                    # (recorded into the first traced request's trace),
+                    # linked as PARENT of every co-batched request's
+                    # dispatch.device span: the trace shows N requests
+                    # sharing one device program, and scatter time is
+                    # the coalesce−device gap.
+                    coalesce = time.monotonic() - t0
+                    bsid = None
+                    for p in grp:
+                        if p.ctx is not None and p.ctx.sampled:
+                            bsid = tracing.record_span(
+                                "batch.coalesce", coalesce, ctx=p.ctx,
+                                attrs={"model": self.name,
+                                       "requests": len(grp), "rows": off})
+                            break
+                    for p in grp:
+                        if p.ctx is not None and p.ctx.sampled:
+                            tracing.record_span(
+                                "dispatch.device", t_device, ctx=p.ctx,
+                                parent_id=bsid,
+                                attrs={"co_batched": len(grp),
+                                       "batch_rows": off})
                 except Exception as exc:  # noqa: BLE001 — scattered per req
                     with _stats_lock:
                         self.stats.errors += len(grp)
@@ -388,7 +484,13 @@ class PredictBatcher:
                 f"request carries {len(rows)} rows; per-request cap is "
                 f"serve_max_batch={cap} — split client-side "
                 "(Model.predict_online does)")
+        t0 = time.monotonic()
         X = design_from_rows(rows, entry.preprocess)
+        # Host-side feature prep on the handler thread, attributed per
+        # request — the queue.wait / dispatch.device spans downstream
+        # come from the dispatcher (ModelBatcher._loop).
+        tracing.record_span("design.build", time.monotonic() - t0,
+                            attrs={"model": name, "rows": len(rows)})
         probs = self._batcher(name).submit(X, entry)
         # .tolist() (C-speed) — this runs per request on the hot path.
         return {

@@ -21,6 +21,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from learningorchestra_tpu.utils import tracing
+
+#: Inbound X-Request-Id values become trace ids verbatim when they look
+#: like ids; anything else (oversized, control chars, header-injection
+#: attempts) is replaced with a fresh id rather than propagated.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
 
 class HttpError(Exception):
     def __init__(self, status: int, message: str,
@@ -78,6 +85,21 @@ class HtmlResponse:
 
     def __init__(self, html: str, status: int = 200):
         self.html = html
+        self.status = status
+
+
+class TextResponse:
+    """A plain-text body — the Prometheus exposition surface
+    (``GET /metrics?format=prometheus``); the version suffix in the
+    default content type is the exposition-format handshake scrapers
+    expect."""
+
+    def __init__(self, text: str,
+                 content_type: str =
+                 "text/plain; version=0.0.4; charset=utf-8",
+                 status: int = 200):
+        self.text = text
+        self.content_type = content_type
         self.status = status
 
 
@@ -202,57 +224,82 @@ def _make_handler(router: Router, request_timeout_s: Optional[float] = None):
             except json.JSONDecodeError:
                 raise HttpError(400, "invalid JSON body")
 
-        def _send_json(self, status: int, payload: Any,
-                       headers: Optional[Dict[str, str]] = None) -> None:
-            data = json.dumps(payload, default=str).encode()
+        def _send_bytes(self, status: int, content_type: str,
+                        data: bytes,
+                        headers: Optional[Dict[str, str]] = None) -> None:
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            # Every response carries the request's trace id: a client
+            # (or a human with curl) can quote it against GET /trace/{id}
+            # and the structured logs without any luck in timing.
+            rid = getattr(self, "_request_id", None)
+            if rid:
+                self.send_header("X-Request-Id", rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
+        def _send_json(self, status: int, payload: Any,
+                       headers: Optional[Dict[str, str]] = None) -> None:
+            self._send_bytes(status, "application/json",
+                             json.dumps(payload, default=str).encode(),
+                             headers)
+
         def _send_file(self, resp: FileResponse) -> None:
             with open(resp.path, "rb") as f:
                 data = f.read()
-            self.send_response(200)
-            self.send_header("Content-Type", resp.content_type)
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            self._send_bytes(200, resp.content_type, data)
 
         def _send_html(self, resp: HtmlResponse) -> None:
-            data = resp.html.encode()
-            self.send_response(resp.status)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            self._send_bytes(resp.status, "text/html; charset=utf-8",
+                             resp.html.encode())
+
+        def _send_text(self, resp: TextResponse) -> None:
+            self._send_bytes(resp.status, resp.content_type,
+                             resp.text.encode())
 
         def _handle(self, method: str) -> None:
-            try:
-                body = self._read_body()
-                status, payload = router.dispatch(method, self.path, body,
-                                                  dict(self.headers.items()))
-                if isinstance(payload, FileResponse):
-                    self._send_file(payload)
-                elif isinstance(payload, HtmlResponse):
-                    self._send_html(payload)
-                else:
-                    self._send_json(status, payload)
-            except HttpError as e:
-                self._send_json(e.status, {"result": e.message},
-                                headers=e.headers)
-            except (socket.timeout, TimeoutError):
-                # Connection-level timeout (half-sent body from a hung or
-                # dead client): re-raise so handle_one_request closes the
-                # connection — answering 500 here would treat a dead peer
-                # as a server bug and keep the handler thread engaged.
-                raise
-            except Exception as e:  # noqa: BLE001 — request boundary
-                traceback.print_exc()
-                self._send_json(500, {"result": f"internal error: {e}"})
+            # The trace id for this request: the client's X-Request-Id
+            # when it looks like one (so retries/evidence quote a stable
+            # id end to end), else freshly minted.
+            inbound = self.headers.get("X-Request-Id") or ""
+            rid = (inbound if _REQUEST_ID_RE.match(inbound)
+                   else tracing.new_id())
+            self._request_id = rid
+            attrs = {"method": method, "route": self.path.split("?", 1)[0]}
+            with tracing.trace("http.handle", trace_id=rid, attrs=attrs):
+                try:
+                    body = self._read_body()
+                    status, payload = router.dispatch(
+                        method, self.path, body, dict(self.headers.items()))
+                    attrs["status"] = status
+                    if isinstance(payload, FileResponse):
+                        self._send_file(payload)
+                    elif isinstance(payload, HtmlResponse):
+                        self._send_html(payload)
+                    elif isinstance(payload, TextResponse):
+                        self._send_text(payload)
+                    else:
+                        self._send_json(status, payload)
+                except HttpError as e:
+                    attrs["status"] = e.status
+                    attrs["error"] = e.message
+                    self._send_json(e.status, {"result": e.message},
+                                    headers=e.headers)
+                except (socket.timeout, TimeoutError):
+                    # Connection-level timeout (half-sent body from a hung
+                    # or dead client): re-raise so handle_one_request
+                    # closes the connection — answering 500 here would
+                    # treat a dead peer as a server bug and keep the
+                    # handler thread engaged. (The root span records the
+                    # error status on its way out.)
+                    raise
+                except Exception as e:  # noqa: BLE001 — request boundary
+                    attrs["status"] = 500
+                    traceback.print_exc()
+                    self._send_json(500, {"result": f"internal error: {e}"})
 
         def do_GET(self):
             self._handle("GET")

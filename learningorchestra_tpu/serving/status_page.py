@@ -130,5 +130,7 @@ def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
 <p style="color:#888;font-size:.75rem">auto-refreshes every
 {refresh_seconds}s — JSON at <a href="/cluster">/cluster</a>,
 <a href="/jobs">/jobs</a>, <a href="/files">/files</a>,
-<a href="/metrics">/metrics</a></p>
+<a href="/metrics">/metrics</a>,
+<a href="/traces">/traces</a>; Prometheus at
+<a href="/metrics?format=prometheus">/metrics?format=prometheus</a></p>
 </body></html>"""

@@ -45,7 +45,6 @@ Run as ``python -m learningorchestra_tpu.supervisor -- <pod command>``;
 from __future__ import annotations
 
 import json
-import logging
 import os
 import signal
 import subprocess
@@ -57,8 +56,9 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Sequence
 
 from learningorchestra_tpu.config import Settings, settings as global_settings
+from learningorchestra_tpu.utils import structlog
 
-log = logging.getLogger("lo_tpu.supervisor")
+log = structlog.get_logger("supervisor")
 
 #: Exit code a pod process uses for "this incarnation cannot continue but
 #: the pod should" — controller lost / stale epoch (serving/__main__.py).
@@ -395,6 +395,5 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 if __name__ == "__main__":
-    logging.basicConfig(level=logging.INFO,
-                        format="%(asctime)s %(name)s %(message)s")
+    structlog.configure()
     sys.exit(main())

@@ -7,7 +7,15 @@ UIs. Here:
 
 - every framework operation (ingest, projection, histogram, each model
   fit, each embedding) records its wall-clock into a process-wide
-  ``OpTimer``; aggregates are served at GET /metrics alongside job stats;
+  ``OpTimer`` — count/total/mean/max PLUS a log-bucketed latency
+  histogram per op, which is what ``GET /metrics?format=prometheus``
+  exposes as real histogram series and what the p50/p99 estimates
+  derive from (a rolling sample window keeps only recent shape; the
+  histogram is exact over the op's whole life at O(#buckets) memory);
+- ``timed``/``device_span`` are span-emitting: under an ambient trace
+  (utils/tracing.py) each timed region also records a span with the
+  exact measured duration, so per-request traces and aggregate metrics
+  can never disagree about the same measurement;
 - setting ``LO_TPU_PROFILE_DIR`` wraps compute jobs in
   ``jax.profiler.trace`` so every XLA op, transfer, and collective lands
   in a TensorBoard-loadable trace — the device-level view Spark's stage UI
@@ -16,35 +24,100 @@ UIs. Here:
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
 from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.utils import tracing
+
+#: Log-spaced histogram bucket upper bounds, seconds (Prometheus-style
+#: 1-2.5-5 ladder from 1 ms to 60 s; one implicit +Inf bucket past the
+#: end). Shared by OpTimer and the serving tier's latency stats so every
+#: histogram on /metrics speaks the same ladder.
+BUCKETS_S: Sequence[float] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def new_histogram() -> List[int]:
+    """Zeroed per-bucket counts (len(BUCKETS_S) + 1: last = +Inf)."""
+    return [0] * (len(BUCKETS_S) + 1)
+
+
+def observe(buckets: List[int], seconds: float) -> None:
+    """Count one observation into its (non-cumulative) bucket."""
+    buckets[bisect.bisect_left(BUCKETS_S, seconds)] += 1
+
+
+def quantile_from_buckets(buckets: Sequence[int],
+                          q: float) -> Optional[float]:
+    """Estimate the q-quantile (seconds) from non-cumulative bucket
+    counts by linear interpolation within the containing bucket — the
+    standard Prometheus ``histogram_quantile`` scheme. The +Inf bucket
+    clamps to the last finite bound (an estimate can't exceed what the
+    ladder resolves). None when empty."""
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(buckets):
+        if c == 0:
+            continue
+        prev = cum
+        cum += c
+        if cum >= target:
+            if i >= len(BUCKETS_S):
+                return BUCKETS_S[-1]
+            lo = BUCKETS_S[i - 1] if i > 0 else 0.0
+            hi = BUCKETS_S[i]
+            return lo + (hi - lo) * max(0.0, min(1.0, (target - prev) / c))
+    return BUCKETS_S[-1]
 
 
 class OpTimer:
-    """Thread-safe aggregate wall-clock stats per operation name."""
+    """Thread-safe aggregate wall-clock stats per operation name.
+
+    An entry exists only once something was recorded into it, so every
+    snapshot entry has ``count >= 1`` by construction — ``mean_s`` is a
+    plain division, never a guarded one that silently reads 0.0 for an
+    empty entry (the old ``max(count, 1)`` bug class)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._stats: Dict[str, Dict[str, float]] = {}
+        self._stats: Dict[str, Dict] = {}
 
     def record(self, name: str, seconds: float) -> None:
         with self._lock:
-            s = self._stats.setdefault(
-                name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = {
+                    "count": 0, "total_s": 0.0, "max_s": 0.0,
+                    "buckets": new_histogram()}
             s["count"] += 1
             s["total_s"] += seconds
             s["max_s"] = max(s["max_s"], seconds)
+            observe(s["buckets"], seconds)
 
-    def snapshot(self) -> Dict[str, Dict[str, float]]:
+    def snapshot(self) -> Dict[str, Dict]:
         with self._lock:
-            return {
-                name: {**s, "mean_s": s["total_s"] / max(s["count"], 1)}
-                for name, s in self._stats.items()
-            }
+            out = {}
+            for name, s in self._stats.items():
+                out[name] = {
+                    "count": s["count"],
+                    "total_s": s["total_s"],
+                    "max_s": s["max_s"],
+                    # count >= 1 always: entries are created by record().
+                    "mean_s": s["total_s"] / s["count"],
+                    "p50_s": quantile_from_buckets(s["buckets"], 0.50),
+                    "p99_s": quantile_from_buckets(s["buckets"], 0.99),
+                    "buckets": list(s["buckets"]),
+                }
+            return out
 
 
 #: Process-global timer (one server process = one metrics surface).
@@ -53,14 +126,18 @@ op_timer = OpTimer()
 
 @contextmanager
 def timed(name: str, timer: Optional[OpTimer] = None):
+    """Time a region into the op timer AND, under an ambient trace,
+    record a span of the same name with the identical duration."""
     t0 = time.time()
     try:
         yield
     finally:
-        (timer or op_timer).record(name, time.time() - t0)
+        dur = time.time() - t0
+        (timer or op_timer).record(name, dur)
+        tracing.record_span(name, dur)
 
 
-def device_span(fn):
+def device_span(fn, name: Optional[str] = None):
     """Run ``fn`` (a thunk whose result is a pytree of jax arrays or a
     value derived from them) and return ``(result, seconds)`` where the
     span covers program dispatch *through blocked completion* — JAX
@@ -73,12 +150,20 @@ def device_span(fn):
     tail — the ``device_s`` figure that separates tunnel/host jitter from
     device compute in the bench. Under overlapped dispatch it includes
     queue waits behind other programs and is reported as such.
+
+    ``name`` additionally records a trace span (ambient context) with
+    the exact same measured duration — the builder passes
+    ``fit.<family>.device`` so a job's trace and its ``fit_device_s``
+    profile figure agree to the digit.
     """
     import jax
 
     t0 = time.time()
     out = jax.block_until_ready(fn())
-    return out, time.time() - t0
+    dur = time.time() - t0
+    if name is not None:
+        tracing.record_span(name, dur)
+    return out, dur
 
 
 #: JAX allows one active profiler trace per process; concurrent jobs that

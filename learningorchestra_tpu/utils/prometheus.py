@@ -1,0 +1,166 @@
+"""Prometheus text exposition (version 0.0.4) for ``GET /metrics``.
+
+One registry, two formats: the JSON ``/metrics`` document (op timer,
+jobs, read pipeline, serving, integrity, tracing) is ALSO rendered as
+Prometheus exposition text when the scrape asks for
+``?format=prometheus`` — generated from the identical snapshot, so the
+two views can never disagree. stdlib-only renderer; no client library.
+
+Mapping conventions:
+
+- ``ops`` entries → ``lo_op_seconds`` histograms labeled ``op=...``
+  (cumulative ``_bucket`` series over the shared
+  :data:`~learningorchestra_tpu.utils.profiling.BUCKETS_S` ladder, plus
+  ``_sum``/``_count``) and a ``lo_op_max_seconds`` gauge;
+- ``jobs`` → ``lo_jobs{status=...}`` gauge;
+- ``read_pipeline`` / ``integrity`` / ``tracing`` counters →
+  ``lo_read_pipeline_*`` / ``lo_integrity_*`` / ``lo_trace_*``;
+- ``serving`` per-model counters → ``lo_serving_*_total{model=...}``,
+  live gauges (``queue_rows``, ``qps``), and the request-latency
+  histogram ``lo_serving_latency_seconds{model=...}`` — the log-bucketed
+  histogram that replaced the old rolling-sample p50/p99 (the JSON
+  view's ``p50_ms``/``p99_ms`` are estimated from the same buckets).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from learningorchestra_tpu.utils.profiling import BUCKETS_S
+
+_COUNTER = "counter"
+_GAUGE = "gauge"
+_HISTOGRAM = "histogram"
+
+
+def _esc(value: Any) -> str:
+    """Escape a label value per the exposition format."""
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(value: Any) -> str:
+    """Render a sample value; integers stay integral for readability."""
+    f = float(value)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _labels(labels: Optional[Dict[str, Any]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: List[str] = []
+        self._typed: set = set()
+
+    def header(self, name: str, mtype: str, help_text: str) -> None:
+        if name in self._typed:
+            return
+        self._typed.add(name)
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+
+    def sample(self, name: str, labels: Optional[Dict[str, Any]],
+               value: Any) -> None:
+        self.lines.append(f"{name}{_labels(labels)} {_fmt(value)}")
+
+    def histogram(self, name: str, labels: Dict[str, Any],
+                  buckets: Sequence[int], total_s: float,
+                  count: int) -> None:
+        """Cumulative ``_bucket`` series from non-cumulative counts."""
+        cum = 0
+        for bound, c in zip(BUCKETS_S, buckets):
+            cum += c
+            self.sample(f"{name}_bucket", {**labels, "le": repr(bound)},
+                        cum)
+        self.sample(f"{name}_bucket", {**labels, "le": "+Inf"}, count)
+        self.sample(f"{name}_sum", labels, total_s)
+        self.sample(f"{name}_count", labels, count)
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def _flat_counters(w: _Writer, prefix: str, doc: Dict[str, Any],
+                   mtype: str, help_text: str) -> None:
+    for key, val in sorted(doc.items()):
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        name = f"{prefix}_{key}"
+        w.header(name, mtype, f"{help_text} ({key})")
+        w.sample(name, None, val)
+
+
+def render(doc: Dict[str, Any]) -> str:
+    """The exposition text for one ``/metrics`` JSON document."""
+    w = _Writer()
+
+    ops = doc.get("ops") or {}
+    if ops:
+        w.header("lo_op_seconds", _HISTOGRAM,
+                 "Wall-clock of framework operations by op name")
+        for op, s in sorted(ops.items()):
+            buckets = s.get("buckets")
+            if buckets is None:
+                continue
+            w.histogram("lo_op_seconds", {"op": op}, buckets,
+                        s.get("total_s", 0.0), s.get("count", 0))
+        w.header("lo_op_max_seconds", _GAUGE,
+                 "Max observed wall-clock per op name")
+        for op, s in sorted(ops.items()):
+            w.sample("lo_op_max_seconds", {"op": op}, s.get("max_s", 0.0))
+
+    jobs = doc.get("jobs") or {}
+    if jobs:
+        w.header("lo_jobs", _GAUGE, "Job records by status")
+        for status, n in sorted(jobs.items()):
+            w.sample("lo_jobs", {"status": status}, n)
+
+    for section, prefix, mtype, help_text in (
+            ("read_pipeline", "lo_read_pipeline", _COUNTER,
+             "Chunk-read pipeline counter"),
+            ("integrity", "lo_integrity", _COUNTER,
+             "Data-plane integrity counter"),
+            # Mixed live values (buffer occupancy) and monotone totals:
+            # gauge is the honest common type.
+            ("tracing", "lo_trace", _GAUGE, "Tracing subsystem metric")):
+        sec = doc.get(section) or {}
+        if sec:
+            _flat_counters(w, prefix, sec, mtype, help_text)
+
+    serving = doc.get("serving") or {}
+    models = serving.get("models") or {}
+    if models:
+        for key in ("requests", "rows", "batches", "rejected",
+                    "timeouts", "errors"):
+            name = f"lo_serving_{key}_total"
+            w.header(name, _COUNTER,
+                     f"Online predict tier {key} per model")
+            for model, m in sorted(models.items()):
+                w.sample(name, {"model": model}, m.get(key, 0))
+        for key in ("queue_rows", "qps", "mean_batch_rows"):
+            name = f"lo_serving_{key}"
+            w.header(name, _GAUGE,
+                     f"Online predict tier live {key} per model")
+            for model, m in sorted(models.items()):
+                w.sample(name, {"model": model}, m.get(key) or 0)
+        w.header("lo_serving_latency_seconds", _HISTOGRAM,
+                 "End-to-end online predict latency per model")
+        for model, m in sorted(models.items()):
+            hist = m.get("latency") or {}
+            buckets = hist.get("buckets")
+            if buckets is None:
+                continue
+            w.histogram("lo_serving_latency_seconds", {"model": model},
+                        buckets, hist.get("sum_s", 0.0),
+                        m.get("requests", 0))
+    aot = serving.get("aot") or {}
+    if aot:
+        _flat_counters(w, "lo_serving_aot", aot, _COUNTER,
+                       "AOT predict-program cache counter")
+
+    return w.text()

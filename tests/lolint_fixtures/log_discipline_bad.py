@@ -1,0 +1,21 @@
+"""log-discipline BAD fixture — parsed by tests, never imported."""
+import logging
+
+
+def handle_request(name):
+    # Bare print: unleveled, no component, no trace ids.
+    print(f"handling {name}")
+    # Root-logger module calls: bypass the lo_tpu tree's structured
+    # handler entirely.
+    logging.info("request %s accepted", name)
+    logging.warning("request %s slow", name)
+
+
+def boot():
+    # Global logging mutation outside structlog.configure().
+    logging.basicConfig(level=logging.INFO)
+    # getLogger outside the lo_tpu tree: same bypass whether chained or
+    # assigned to a module-level `log`.
+    logging.getLogger(__name__).warning("escaped the funnel")
+    log = logging.getLogger("some.other.tree")
+    log.info("also escaped")

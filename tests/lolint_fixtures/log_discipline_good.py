@@ -1,0 +1,24 @@
+"""log-discipline GOOD fixture — parsed by tests, never imported."""
+import logging
+
+from learningorchestra_tpu.utils.structlog import configure, get_logger
+
+log = get_logger("fixture")
+
+
+def handle_request(name):
+    # Named logger through the structlog funnel: leveled, componentized,
+    # trace ids stamped by the formatter.
+    log.info("handling %s", name)
+    log.warning("request %s slow", name)
+    # Logger-instance calls (not module-level logging.*) are fine even
+    # on a conventionally obtained stdlib logger.
+    other = logging.getLogger("lo_tpu.fixture.other")
+    other.debug("detail")
+
+
+def boot():
+    # Handler/level wiring goes through structlog.configure().
+    configure()
+    # Chained form is fine when the literal name sits under the tree.
+    logging.getLogger("lo_tpu.fixture.boot").info("under the tree")

@@ -35,6 +35,8 @@ CASES = {
                          "thread_lifecycle"),
     "handler-error-map": ("learningorchestra_tpu/serving/fx.py",
                           "handler_error_map"),
+    "log-discipline": ("learningorchestra_tpu/fx.py",
+                       "log_discipline"),
     "failpoint-coverage": ("learningorchestra_tpu/catalog/fx.py",
                            "failpoint_coverage"),
 }

@@ -331,3 +331,69 @@ def test_elastic_recovery_survives_repeated_failures(tmp_path):
     finally:
         sup.close()
         runner.join(timeout=15)
+
+
+_TRACE_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "trace_child.py")
+
+
+@pytest.mark.slow
+def test_two_process_trace_propagation(tmp_path):
+    """ISSUE 9: one ingest-triggered trace id covers spans from BOTH pod
+    processes after the merge — the dispatched spec carries process 0's
+    trace context over the SPMD job channel, the worker records its
+    prep/device spans under that trace id, ships them back, and process
+    0's merged tree attributes per-process time."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _TRACE_CHILD, str(i), "2", str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    deadline = time.time() + 600            # one shared wall budget
+    try:
+        for p in procs:
+            out, _ = p.communicate(
+                timeout=max(30.0, deadline - time.time()))
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        # Collect the killed processes' buffered output — the hung
+        # process's log IS the diagnostic.
+        for p in procs[len(outs):]:
+            try:
+                outs.append(p.communicate(timeout=10)[0])
+            except Exception:  # noqa: BLE001 — best-effort diagnostics
+                outs.append("<no output captured>")
+        pytest.fail("2-process trace run deadlocked:\n"
+                    + "\n---\n".join(o or "" for o in outs))
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"process {i} failed:\n{outs[i]}"
+
+    with open(tmp_path / "result.json") as f:
+        result = json.load(f)
+    tree = result["tree"]
+    assert tree["trace_id"] == result["trace_id"]
+    # Spans from BOTH processes merged under the one trace id.
+    assert tree["processes"] == [0, 1], tree["processes"]
+    by_process = {}
+    for s in tree["spans"]:
+        by_process.setdefault(s["process"], set()).add(s["name"])
+    # Process 0's side: the root + its own per-family fit spans.
+    assert "job.model_builder" in by_process[0]
+    assert "fit.lr.device" in by_process[0]
+    # The worker's side: prep + device ops under the SAME trace, parented
+    # to the coordinator's dispatching span.
+    assert {"worker.prep", "dispatch.device"} <= by_process[1]
+    root_span_id = next(s["span_id"] for s in tree["spans"]
+                        if s["parent_id"] is None)
+    worker_spans = [s for s in tree["spans"] if s["process"] == 1]
+    assert all(s["parent_id"] == root_span_id for s in worker_spans), \
+        worker_spans

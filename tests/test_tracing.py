@@ -1,0 +1,467 @@
+"""End-to-end tracing plane (ISSUE 9): span ring buffer, parent links
+through the serving batcher, HTTP trace roots + X-Request-Id contract,
+job traces whose device spans reconcile with the job profile, Prometheus
+exposition (live-scraped and line-regex validated), histogram-aware
+OpTimer, and the structured logger's trace-id stamping."""
+
+import io
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu.client import Context, DatabaseApi, Observability
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.serving.app import App
+from learningorchestra_tpu.utils import structlog, tracing
+from learningorchestra_tpu.utils.profiling import (
+    BUCKETS_S, OpTimer, op_timer, quantile_from_buckets, timed)
+
+
+@pytest.fixture(autouse=True)
+def _tracing_isolation():
+    tracing.reset()
+    tracing.set_sample(None)
+    tracing.set_capacity(None)
+    yield
+    tracing.reset()
+    tracing.set_sample(None)
+    tracing.set_capacity(None)
+
+
+# -- core span mechanics ------------------------------------------------------
+
+def test_span_nesting_and_parent_links():
+    with tracing.trace("root", attrs={"route": "/x"}) as root:
+        with tracing.span("mid") as mid:
+            with tracing.span("leaf", rows=3):
+                pass
+    tree = tracing.trace_tree(root.trace_id)
+    assert tree["span_count"] == 3
+    by_name = {s["name"]: s for s in tree["spans"]}
+    assert by_name["root"]["parent_id"] is None
+    assert by_name["mid"]["parent_id"] == root.span_id
+    assert by_name["leaf"]["parent_id"] == mid.span_id
+    assert by_name["leaf"]["attrs"] == {"rows": 3}
+    # Nested view mirrors the links.
+    assert tree["roots"][0]["name"] == "root"
+    assert tree["roots"][0]["children"][0]["name"] == "mid"
+    assert tree["roots"][0]["children"][0]["children"][0]["name"] == "leaf"
+
+
+def test_error_status_records_and_reraises():
+    with pytest.raises(ValueError):
+        with tracing.trace("boom") as ctx:
+            raise ValueError("nope")
+    (span,) = tracing.spans_for(ctx.trace_id)
+    assert span["status"] == "error"
+    assert "nope" in span["error"]
+
+
+def test_ring_buffer_eviction_is_bounded():
+    tracing.set_capacity(8)
+    ids = []
+    for i in range(20):
+        with tracing.trace(f"t{i}") as ctx:
+            pass
+        ids.append(ctx.trace_id)
+    counters = tracing.counters_snapshot()
+    assert counters["buffer_spans"] == 8
+    assert counters["spans_recorded"] == 20
+    assert counters["spans_dropped"] == 12
+    # Oldest evicted, newest retained.
+    assert tracing.spans_for(ids[0]) == []
+    assert len(tracing.spans_for(ids[-1])) == 1
+
+
+def test_sampling_zero_mints_ids_but_records_nothing():
+    tracing.set_sample(0.0)
+    with tracing.trace("unsampled") as ctx:
+        assert ctx.trace_id                     # id still propagates
+        with tracing.span("child") as c:
+            assert c is ctx or c is None        # no child bookkeeping
+        assert tracing.record_span("manual", 0.01) is None
+    assert tracing.spans_for(ctx.trace_id) == []
+    assert tracing.counters_snapshot()["traces_unsampled"] == 1
+
+
+def test_ingest_merges_and_tree_dedupes():
+    with tracing.trace("local") as ctx:
+        pass
+    worker_doc = {"trace_id": ctx.trace_id, "span_id": "w1",
+                  "parent_id": ctx.span_id, "name": "dispatch.device",
+                  "start": 1.0, "duration_ms": 5.0, "process": 1}
+    assert tracing.ingest([worker_doc, worker_doc, {"junk": True}]) == 2
+    tree = tracing.trace_tree(ctx.trace_id)
+    assert tree["processes"] == [0, 1]
+    # Duplicate shipment collapses to one node.
+    assert tree["span_count"] == 2
+    assert [c["name"] for c in tree["roots"][0]["children"]] == [
+        "dispatch.device"]
+
+
+def test_pop_spans_removes_from_buffer():
+    with tracing.trace("job") as ctx:
+        with tracing.span("inner"):
+            pass
+    popped = tracing.pop_spans(ctx.trace_id)
+    assert len(popped) == 2
+    assert tracing.spans_for(ctx.trace_id) == []
+
+
+def test_recent_traces_filters():
+    with tracing.trace("http.handle",
+                       attrs={"route": "/files", "status": 200}):
+        pass
+    # The async-job shape: the job span is a CHILD of the submitting
+    # request's trace — the kind filter must still find the sweep.
+    with tracing.trace("http.handle", attrs={"route": "/models"}) as req:
+        with tracing.span("job.model_builder", kind="model_builder"):
+            pass
+    assert [t["trace_id"] for t in tracing.recent_traces(
+        route="/files")] != [req.trace_id]
+    (got,) = tracing.recent_traces(kind="model_builder")
+    assert got["trace_id"] == req.trace_id
+    assert got["kinds"] == ["model_builder"]
+    assert got["spans"] == 2
+    assert tracing.recent_traces(min_ms=1e7) == []
+    # One summary per trace, newest first.
+    assert len(tracing.recent_traces()) == 2
+
+
+# -- OpTimer histograms (satellite: the max(count,1) guard is gone) ----------
+
+def test_op_timer_histogram_aware_and_never_empty():
+    t = OpTimer()
+    t.record("op.a", 0.004)
+    t.record("op.a", 0.006)
+    snap = t.snapshot()
+    assert set(snap) == {"op.a"}            # no empty entries, ever
+    s = snap["op.a"]
+    assert s["count"] == 2
+    assert s["mean_s"] == pytest.approx(0.005)
+    assert sum(s["buckets"]) == s["count"]
+    assert len(s["buckets"]) == len(BUCKETS_S) + 1
+    assert s["p50_s"] is not None and s["p99_s"] >= s["p50_s"]
+
+
+def test_quantile_from_buckets_interpolates():
+    buckets = [0] * (len(BUCKETS_S) + 1)
+    buckets[3] = 100                        # all mass in (0.005, 0.01]
+    est = quantile_from_buckets(buckets, 0.5)
+    assert 0.005 <= est <= 0.01
+    assert quantile_from_buckets([0] * (len(BUCKETS_S) + 1), 0.5) is None
+    # +Inf bucket clamps to the last finite bound.
+    top = [0] * (len(BUCKETS_S) + 1)
+    top[-1] = 5
+    assert quantile_from_buckets(top, 0.99) == BUCKETS_S[-1]
+
+
+def test_timed_emits_matching_span():
+    with tracing.trace("op-ctx") as ctx:
+        with timed("tracing_test.timed_op"):
+            pass
+    spans = [s for s in tracing.spans_for(ctx.trace_id)
+             if s["name"] == "tracing_test.timed_op"]
+    assert len(spans) == 1
+    assert op_timer.snapshot()["tracing_test.timed_op"]["count"] >= 1
+
+
+# -- parent linking under the batcher ----------------------------------------
+
+def test_batcher_parent_links():
+    from learningorchestra_tpu.serving.batcher import ModelBatcher, _Stats
+
+    class _Entry:
+        def predict(self, X):
+            return np.tile(np.asarray([[0.25, 0.75]], np.float32),
+                           (len(X), 1))
+
+    cfg = Settings()
+    b = ModelBatcher("tm", cfg, _Stats())
+    entry = _Entry()
+    roots = {}
+
+    def one_request(i):
+        with tracing.trace("http.handle") as ctx:
+            roots[i] = ctx
+            b.submit(np.zeros((2, 3), np.float32), entry)
+
+    try:
+        threads = [threading.Thread(target=one_request, args=(i,),
+                                    name=f"req-{i}") for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        b.stop()
+
+    for ctx in roots.values():
+        spans = tracing.spans_for(ctx.trace_id)
+        by_name = {s["name"]: s for s in spans}
+        # queue.wait hangs off the request's root span.
+        assert by_name["queue.wait"]["parent_id"] == ctx.span_id
+        # dispatch.device's parent is the coalesced batch.coalesce span
+        # (recorded into the first co-batched request's trace).
+        dispatch = by_name["dispatch.device"]
+        assert dispatch["attrs"]["co_batched"] >= 1
+        coalesce_ids = set()
+        for other in roots.values():
+            for s in tracing.spans_for(other.trace_id):
+                if s["name"] == "batch.coalesce":
+                    coalesce_ids.add(s["span_id"])
+        assert dispatch["parent_id"] in coalesce_ids
+
+
+def test_serving_percentiles_track_recent_window():
+    """Review finding: a long-lived server's JSON-view p50/p99 must
+    follow the RECENT latency regime, not drown a regression in
+    millions of historical observations — while the lifetime histogram
+    (the Prometheus series) keeps every observation."""
+    from learningorchestra_tpu.serving.batcher import _Stats
+
+    s = _Stats()
+    for _ in range(5000):
+        s.observe(0.005)                     # days of fast traffic
+    for _ in range(2):                       # regression: two epochs of
+        s._rotated_at -= 1e3                 # slow traffic (forced
+        for _ in range(50):                  # rotation)
+            s.observe(0.5)
+    snap = s.snapshot(0)
+    # The window now holds only slow epochs: p50 reflects the regression
+    # even though 98% of lifetime observations were fast.
+    assert snap["p50_ms"] > 100, snap["p50_ms"]
+    # The lifetime series kept everything for scrapers.
+    assert sum(snap["latency"]["buckets"]) == 5100
+    # An idle gap longer than both epochs clears the window instead of
+    # promoting a stale epoch into "recent": percentiles fall back to
+    # the lifetime shape (dominated by the fast regime here).
+    s._rotated_at -= 1e4
+    snap = s.snapshot(0)
+    assert snap["p50_ms"] < 100, snap["p50_ms"]
+
+
+# -- live server: HTTP roots, /traces, /trace/{id}, prometheus ---------------
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("trace_serve")
+    cfg = Settings()
+    cfg.store_root = str(tmp / "store")
+    cfg.image_root = str(tmp / "images")
+    cfg.port = 0
+    cfg.persist = True
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(0)
+    n = 400
+    y = rng.integers(0, 2, n)
+    centers = rng.normal(size=(2, 4)) * 2.0
+    X = centers[y] + rng.normal(size=(n, 4))
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["label"] = y.astype(np.int64)
+    for name in ("tr_train", "tr_test"):
+        app.store.create(name, columns={k: v.copy()
+                                        for k, v in cols.items()})
+        app.store.finish(name)
+    server = app.serve(background=True)
+    ctx = Context(f"http://127.0.0.1:{server.port}", poll_seconds=0.05,
+                  timeout=120)
+    yield ctx, app
+    server.stop()
+
+
+def test_http_root_span_and_request_id_contract(served):
+    ctx, app = served
+    rid = "req-abc.123"
+    resp = requests.get(ctx.url("/files"), headers={"X-Request-Id": rid})
+    assert resp.status_code == 200
+    # The response echoes the inbound id; the trace is queryable by it.
+    assert resp.headers["X-Request-Id"] == rid
+    tree = requests.get(ctx.url(f"/trace/{rid}")).json()
+    root = tree["roots"][0]
+    assert root["name"] == "http.handle"
+    assert root["attrs"]["route"] == "/files"
+    assert root["attrs"]["status"] == 200
+    # A garbage inbound id is replaced, not propagated.
+    bad = requests.get(ctx.url("/files"),
+                       headers={"X-Request-Id": "x" * 200})
+    assert bad.headers["X-Request-Id"] != "x" * 200
+    # Errors carry an id too, and /traces can filter the route.
+    miss = requests.get(ctx.url("/files/definitely_missing"))
+    assert miss.status_code == 404 and miss.headers["X-Request-Id"]
+    listed = requests.get(
+        ctx.url("/traces"), params={"route": "/files/definitely_missing"}
+    ).json()
+    assert listed and listed[0]["attrs"]["status"] == 404
+
+
+def test_unknown_trace_404s(served):
+    ctx, _app = served
+    assert requests.get(ctx.url("/trace/feedfacefeedface")).status_code == 404
+
+
+def test_client_wrappers_and_error_request_id(served):
+    ctx, _app = served
+    obs = Observability(ctx)
+    assert isinstance(obs.traces(limit=5), list)
+    with pytest.raises(RuntimeError) as exc:
+        DatabaseApi(ctx).read_file("definitely_missing")
+    m = re.search(r"\[request-id ([0-9a-f]{16})\]", str(exc.value))
+    assert m, f"no request id in client error: {exc.value}"
+    tree = ctx.trace(m.group(1))
+    assert tree["roots"][0]["attrs"]["status"] == 404
+
+
+def test_sweep_job_trace_reconciles_with_profile(served):
+    """Acceptance: a classifier-sweep job's trace shows the PR-3
+    structure — per-family host_prep/device/finish spans, correctly
+    parented — and the device spans sum to within 5% of the job
+    profile's fit_device_s."""
+    ctx, app = served
+    resp = requests.post(ctx.url("/models"), json={
+        "training_filename": "tr_train", "test_filename": "tr_test",
+        "prediction_filename": "tr_pred",
+        "classificators_list": ["lr", "nb"], "label": "label",
+        "sync": False})
+    assert resp.status_code == 201, resp.text
+    app.jobs.wait_all(timeout=120)
+    (job,) = [j for j in requests.get(ctx.url("/jobs")).json()
+              if j["kind"] == "model_builder"]
+    assert job["status"] == "done"
+    assert job["trace_id"]
+    profile = job["profile"]["fit_device_s"]
+
+    tree = requests.get(ctx.url(f"/trace/{job['trace_id']}")).json()
+    by_name = {}
+    for s in tree["spans"]:
+        by_name.setdefault(s["name"], []).append(s)
+    # The async job joins the submitting POST's trace.
+    assert by_name["http.handle"][0]["attrs"]["route"] == "/models"
+    (job_span,) = by_name["job.model_builder"]
+    (design,) = by_name["design.build"]
+    assert design["parent_id"] == job_span["span_id"]
+    for fam in ("lr", "nb"):
+        (fit,) = by_name[f"fit.{fam}"]
+        assert fit["parent_id"] == job_span["span_id"]
+        for phase in ("host_prep", "device", "finish"):
+            (ps,) = by_name[f"fit.{fam}.{phase}"]
+            assert ps["parent_id"] == fit["span_id"], (fam, phase)
+        (dev,) = by_name[f"fit.{fam}.device"]
+        # The trace's device span and the profile's fit_device_s are the
+        # same measurement — they must agree (5% covers rounding).
+        assert dev["duration_ms"] / 1e3 == pytest.approx(
+            profile[fam], rel=0.05, abs=5e-4), (fam, profile)
+
+
+def test_failed_family_fit_span_records_error(served):
+    """A failing family's fit.<c> span must carry status=error — the
+    trace view and the job report may never disagree about whether a
+    family succeeded (review finding: the except used to sit inside the
+    span, so failures recorded as ok)."""
+    from learningorchestra_tpu.models.builder import ModelBuilder
+
+    _ctx, app = served
+    mb = ModelBuilder(app.store, app.runtime, app.cfg)
+    with tracing.trace("job.model_builder") as ctx:
+        reports = mb.build("tr_train", "tr_test", "tr_failspan", ["lr"],
+                           "label", hparams={"lr": {"bogus_knob": 1}})
+    assert "error" in reports[0].metrics
+    spans = {s["name"]: s for s in tracing.spans_for(ctx.trace_id)}
+    assert spans["fit.lr"]["status"] == "error"
+    assert "bogus_knob" in spans["fit.lr"]["error"]
+
+
+#: Exposition-format line shapes (version 0.0.4): comments, and samples
+#: with optional labels and a float/+Inf/NaN value.
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" (?:[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|\+Inf|NaN))$")
+
+
+def test_prometheus_exposition_live_scrape(served):
+    """Tier-1 smoke (CI satellite): scrape ?format=prometheus from a
+    live server and validate it parses — every line matches the
+    exposition grammar, histogram buckets are cumulative, and +Inf
+    equals _count."""
+    ctx, _app = served
+    op_timer.record("tracing_test.prom_op", 0.003)
+    resp = requests.get(ctx.url("/metrics"),
+                        params={"format": "prometheus"})
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    text = resp.text
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+
+    # Histogram invariants for the op we just recorded.
+    bucket_re = re.compile(
+        r'^lo_op_seconds_bucket\{op="tracing_test\.prom_op",le="([^"]+)"\}'
+        r" (\d+)$", re.M)
+    buckets = bucket_re.findall(text)
+    assert buckets and buckets[-1][0] == "+Inf"
+    counts = [int(c) for _le, c in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    count_re = re.search(
+        r'^lo_op_seconds_count\{op="tracing_test\.prom_op"\} (\d+)$',
+        text, re.M)
+    assert int(count_re.group(1)) == counts[-1]
+    # The JSON view comes from the same registry snapshot.
+    doc = requests.get(ctx.url("/metrics")).json()
+    assert doc["ops"]["tracing_test.prom_op"]["count"] == counts[-1]
+    assert "tracing" in doc
+
+
+# -- structured logs ----------------------------------------------------------
+
+def _restore_logger_tree():
+    import logging
+
+    root = logging.getLogger(structlog.ROOT)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.propagate = True
+    root.setLevel(logging.NOTSET)
+
+
+def test_structlog_json_carries_trace_ids():
+    cfg = Settings()
+    cfg.log_format = "json"
+    buf = io.StringIO()
+    structlog.configure(cfg, stream=buf)
+    try:
+        log = structlog.get_logger("tracing_test")
+        with tracing.trace("logged-op") as ctx:
+            log.info("inside %s", "trace")
+        log.info("outside")
+        lines = [json.loads(ln) for ln in
+                 buf.getvalue().strip().splitlines()]
+        assert lines[0]["msg"] == "inside trace"
+        assert lines[0]["trace_id"] == ctx.trace_id
+        assert lines[0]["logger"] == "lo_tpu.tracing_test"
+        assert "trace_id" not in lines[1]
+    finally:
+        _restore_logger_tree()
+
+
+def test_structlog_text_appends_trace_ids():
+    cfg = Settings()
+    cfg.log_format = "text"
+    buf = io.StringIO()
+    structlog.configure(cfg, stream=buf)
+    try:
+        log = structlog.get_logger("tracing_test")
+        with tracing.trace("logged-op") as ctx:
+            log.warning("slow thing")
+        line = buf.getvalue().strip()
+        assert f"trace={ctx.trace_id}" in line
+        assert "slow thing" in line
+    finally:
+        _restore_logger_tree()

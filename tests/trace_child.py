@@ -1,0 +1,79 @@
+"""Child process for the 2-process trace-propagation test
+(tests/test_multiprocess.py::test_two_process_trace_propagation).
+
+Run as: python tests/trace_child.py <process_id> <num_processes>
+<coord_port> <shared_root>. Process 0 dispatches one ingest-triggered
+model build under an active trace; the worker's spans ride the SPMD job
+channel back, and process 0 dumps the MERGED trace tree to result.json
+so the test can assert one trace id covers spans from both processes.
+"""
+
+import json
+import os
+import sys
+
+pid, nprocs, port, root = (int(sys.argv[1]), int(sys.argv[2]),
+                           int(sys.argv[3]), sys.argv[4])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+os.environ["LO_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (jax 0.4.x needs explicit gloo)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nprocs, process_id=pid)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from learningorchestra_tpu.catalog.store import DatasetStore  # noqa: E402
+from learningorchestra_tpu.config import Settings  # noqa: E402
+from learningorchestra_tpu.parallel import spmd  # noqa: E402
+from learningorchestra_tpu.parallel.mesh import MeshRuntime  # noqa: E402
+from learningorchestra_tpu.utils import tracing  # noqa: E402
+
+cfg = Settings()
+cfg.store_root = os.path.join(root, "store")
+cfg.persist = True
+store = DatasetStore(cfg)
+runtime = MeshRuntime(cfg)
+
+
+def make_split(seed, n):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    y = ((a + b + 0.2 * rng.normal(size=n)) > 0).astype(np.int64)
+    return {"a": a, "b": b, "label": y}
+
+
+if pid == 0:
+    from learningorchestra_tpu.models.builder import ModelBuilder
+
+    store.create("tp_train", columns=make_split(0, 3000), finished=True)
+    store.create("tp_test", columns=make_split(1, 800), finished=True)
+    mb = ModelBuilder(store, runtime, cfg)
+    try:
+        # The ingest-triggered shape: one trace opened where the request
+        # would be, covering the dispatched build (jobs.py does exactly
+        # this with the submitting request's context).
+        with tracing.trace("job.model_builder",
+                           attrs={"kind": "model_builder"}) as ctx:
+            reports = mb.build("tp_train", "tp_test", "tp_pred", ["lr"],
+                               "label")
+        assert "error" not in reports[0].metrics, reports[0].metrics
+        tree = tracing.trace_tree(ctx.trace_id)
+    finally:
+        spmd.shutdown_workers()
+    with open(os.path.join(root, "result.json"), "w") as f:
+        json.dump({"trace_id": ctx.trace_id, "tree": tree}, f)
+else:
+    spmd.worker_loop(store, runtime)

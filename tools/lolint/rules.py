@@ -424,6 +424,75 @@ class HandlerErrorMapRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# log-discipline
+# ---------------------------------------------------------------------------
+
+#: Module-level ``logging.X(...)`` calls that go through the ROOT logger
+#: (or mutate global logging config) instead of a named ``lo_tpu.*``
+#: logger — lines emitted that way carry no component name and bypass
+#: the structured formatter's trace-id stamping entirely.
+_ROOT_LOGGER_CALLS = {"debug", "info", "warning", "warn", "error",
+                      "exception", "critical", "fatal", "log",
+                      "basicConfig"}
+
+
+class LogDisciplineRule(Rule):
+    name = "log-discipline"
+    description = ("package code logs through utils/structlog "
+                   "(named lo_tpu.* loggers): no bare print(), no "
+                   "root-logger logging.* calls or basicConfig")
+
+    #: structlog itself legitimately owns the handler/formatter wiring.
+    EXEMPT = (f"{PACKAGE}/utils/structlog.py",)
+
+    def applies(self, relpath: str) -> bool:
+        return _in(relpath, PACKAGE) and relpath not in self.EXEMPT
+
+    def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname == "print":
+                yield Finding(
+                    self.name, pf.path, node.lineno, node.col_offset,
+                    "bare print() in package code: unleveled, "
+                    "unfilterable, and invisible to the structured "
+                    "logger's trace-id stamping — use "
+                    "structlog.get_logger(...)", pf.symbol_of(node))
+            elif cname.startswith("logging.") and \
+                    cname.rsplit(".", 1)[-1] in _ROOT_LOGGER_CALLS:
+                yield Finding(
+                    self.name, pf.path, node.lineno, node.col_offset,
+                    f"{cname}() goes through the ROOT logger (or mutates "
+                    "global logging config): package code logs through a "
+                    "named structlog.get_logger(...) logger so every "
+                    "line carries its component and trace ids",
+                    pf.symbol_of(node))
+            elif cname in ("logging.getLogger", "getLogger"):
+                # Any getLogger whose name literal is not under the
+                # lo_tpu tree mints a logger the structured handler
+                # never sees — whether used chained, assigned to a
+                # module `log`, or passed around. __name__ yields
+                # `learningorchestra_tpu.*`, which is exactly the
+                # pre-PR-9 bypass.
+                arg = node.args[0] if node.args else None
+                under_tree = (isinstance(arg, ast.Constant)
+                              and isinstance(arg.value, str)
+                              and (arg.value == "lo_tpu"
+                                   or arg.value.startswith("lo_tpu.")))
+                if not under_tree:
+                    yield Finding(
+                        self.name, pf.path, node.lineno, node.col_offset,
+                        f"{cname}() with a name outside the lo_tpu tree "
+                        "(dynamic, __name__, or bare): lines emitted "
+                        "through it bypass the structured handler — no "
+                        "level policy, no trace/span ids; use "
+                        "structlog.get_logger(<component>)",
+                        pf.symbol_of(node))
+
+
+# ---------------------------------------------------------------------------
 # failpoint-coverage
 # ---------------------------------------------------------------------------
 
@@ -519,6 +588,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     EnvDisciplineRule(),
     ThreadLifecycleRule(),
     HandlerErrorMapRule(),
+    LogDisciplineRule(),
     FailpointCoverageRule(),
 )
 
