@@ -98,7 +98,12 @@ class Context:
                                        self.backoff_seconds * (2 ** attempt)))
 
     def request(self, method: str, path: str,
-                timeout: Optional[float] = None, **kwargs):
+                timeout: Optional[float] = None,
+                retry_503: bool = True, **kwargs):
+        """``retry_503=False`` returns a 503 response immediately instead
+        of backing off: a health probe's 503 IS the answer (degraded),
+        not backpressure to wait out. Connection-error retries keep
+        their normal budget either way."""
         deadline = timeout if timeout is not None else self.request_timeout
         retries = self.retries
         if method.upper() == "POST":
@@ -129,7 +134,7 @@ class Context:
                     raise
                 attempt += 1
                 continue
-            if resp.status_code == 503 and attempt < retries:
+            if resp.status_code == 503 and retry_503 and attempt < retries:
                 # Pod mid-recovery (supervisor restart): honor the
                 # server's backoff hint, clamped.
                 try:
@@ -385,6 +390,42 @@ class Observability(_ServiceClient):
 
     def trace(self, trace_id: str) -> Dict:
         return self.context.trace(trace_id)
+
+    # -- resource & capacity plane (GET /resources, /alerts, /healthz) -------
+
+    def resources(self) -> Dict:
+        """Per-device HBM + host + disk + compile snapshot of the server
+        process (plus last-known worker snapshots on a pod)."""
+        return ResponseTreat.treatment(self.context.get("/resources"))
+
+    def alerts(self) -> Dict:
+        """The SLO alert engine's state: firing rule names plus every
+        rule's value/threshold/streaks (docs/observability.md has the
+        rule table)."""
+        return ResponseTreat.treatment(self.context.get("/alerts"))
+
+    def healthz(self) -> Dict:
+        """The deep health rollup. Returns the check document on 200;
+        raises on 503 with the FIRING ALERT NAMES in the message — a
+        degraded service names its reasons instead of a bare status
+        code. The probe never retries the 503 (the 503 is the answer)."""
+        resp = self.context.get("/healthz", retry_503=False)
+        try:
+            doc = resp.json()
+        except ValueError:
+            doc = {}
+        if resp.status_code == 503:
+            checks = doc.get("checks") or {}
+            firing = (checks.get("alerts") or {}).get("firing") or []
+            failed = sorted(k for k, c in checks.items()
+                            if isinstance(c, dict) and not c.get("ok"))
+            rid = resp.headers.get("X-Request-Id")
+            raise RuntimeError(
+                "healthz degraded: failing checks "
+                f"{failed or ['unknown']}; firing alerts "
+                f"{firing or ['none']}"
+                + (f" [request-id {rid}]" if rid else ""))
+        return ResponseTreat.treatment(resp)
 
 
 class Model(_ServiceClient):

@@ -299,6 +299,57 @@ class Settings:
         default_factory=lambda: _env("LO_TPU_LOG_LEVEL", "INFO")
     )
 
+    # --- resource & capacity plane (utils/resources.py, utils/alerts.py) ---
+    #: Evaluation-window length (seconds) of the declarative alert engine:
+    #: rule conditions are (re)checked at most once per window, driven by
+    #: /metrics, /alerts, /healthz and status-page reads — the Prometheus
+    #: scrape-window model. 0 evaluates on every read (tests).
+    alert_window_s: float = field(
+        default_factory=lambda: _env("LO_TPU_ALERT_WINDOW_S", 15.0)
+    )
+    #: Consecutive bad windows before a threshold rule (serving p99,
+    #: queue rejection rate) transitions to FIRING — the fire-side
+    #: hysteresis that keeps one jittery window from paging anyone.
+    #: Event rules (pod degraded, disk watermark, corruption/worker-error
+    #: increments) fire on a single window regardless.
+    alert_for_windows: int = field(
+        default_factory=lambda: _env("LO_TPU_ALERT_FOR_WINDOWS", 2)
+    )
+    #: Consecutive clean windows before a firing alert resolves — the
+    #: resolve-side hysteresis (a flapping condition stays visibly FIRING
+    #: instead of strobing).
+    alert_clear_windows: int = field(
+        default_factory=lambda: _env("LO_TPU_ALERT_CLEAR_WINDOWS", 2)
+    )
+    #: Serving-latency SLO: the online predict tier's recent-window p99
+    #: (milliseconds, per model — worst model counts) above this for
+    #: ``alert_for_windows`` windows fires ``serving_p99_slo``. 0 disables
+    #: the rule.
+    slo_p99_ms: float = field(
+        default_factory=lambda: _env("LO_TPU_SLO_P99_MS", 500.0)
+    )
+    #: Queue-rejection-rate SLO: rejected / offered requests per window
+    #: at or above this ratio fires ``serving_reject_rate`` (sustained
+    #: backpressure — capacity, not a blip). 0 disables the rule.
+    slo_reject_rate: float = field(
+        default_factory=lambda: _env("LO_TPU_SLO_REJECT_RATE", 0.05)
+    )
+    #: Disk-headroom watermark (MiB) for the chunk store's filesystem:
+    #: free bytes under it fires ``disk_free_low`` and degrades
+    #: ``GET /healthz`` — ingest/journal writes are about to start
+    #: failing. 0 disables the check.
+    disk_free_watermark_mb: int = field(
+        default_factory=lambda: _env("LO_TPU_DISK_FREE_WATERMARK_MB", 512)
+    )
+    #: Allow ``POST /debug/profile`` to capture an on-demand
+    #: ``jax.profiler`` trace (N seconds, written under
+    #: ``<store_root>/_profiles``). Off by default: profiling costs real
+    #: overhead and writes operator-readable traces to disk, so it is an
+    #: explicit opt-in, never ambient.
+    debug_profile: bool = field(
+        default_factory=lambda: _env("LO_TPU_DEBUG_PROFILE", False, bool)
+    )
+
     def replace(self, **kw) -> "Settings":
         new = Settings()
         for f in fields(self):

@@ -21,6 +21,7 @@ import threading
 import time
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -35,6 +36,36 @@ from learningorchestra_tpu.utils.profiling import op_timer
 _job_record: contextvars.ContextVar = contextvars.ContextVar(
     "lo_job_record", default=None)
 
+#: Serializes profile merges: watermark updates arrive from concurrent
+#: family threads (builder's pipelined sweep) and from the SPMD span
+#: drain, and a lost read-modify-write would silently drop a family's
+#: entry. Every merge still publishes a FRESH dict (never mutates the
+#: published one), so /jobs listings stay safe to copy lock-free.
+_profile_lock = threading.Lock()
+
+
+def current_job_record():
+    """The ambient managed-job record, or None outside one — capture it
+    before fanning work out to a thread pool (pool threads carry no
+    ContextVar context) and re-attach with :func:`attach_job_record`,
+    the same discipline as ``tracing.attach``."""
+    return _job_record.get()
+
+
+@contextmanager
+def attach_job_record(rec):
+    """Make an explicitly captured job record ambient on this thread, so
+    profile/watermark recording from fan-out threads (the builder's
+    per-family fit threads) lands on the right job. None = no-op."""
+    if rec is None:
+        yield
+        return
+    token = _job_record.set(rec)
+    try:
+        yield
+    finally:
+        _job_record.reset(token)
+
 
 def record_job_profile(**entries: Any) -> None:
     """Merge profiling metadata into the current job's record (no-op when
@@ -44,7 +75,50 @@ def record_job_profile(**entries: Any) -> None:
     ``profile`` can never see it change size mid-iteration."""
     rec = _job_record.get()
     if rec is not None:
-        rec.profile = {**rec.profile, **entries}
+        with _profile_lock:
+            rec.profile = {**rec.profile, **entries}
+
+
+def record_job_watermarks(*, peak_hbm_bytes: Optional[int] = None,
+                          compile_s: Optional[float] = None,
+                          host_rss_delta: Optional[int] = None,
+                          family: Optional[str] = None,
+                          family_stats: Optional[Dict[str, Any]] = None
+                          ) -> None:
+    """Merge resource watermarks into the current job's profile with
+    watermark semantics (utils/resources.py is the sampler): peaks
+    max-merge, ``compile_s`` max-merges too (phase deltas are subsets of
+    the whole-job window, so the largest observed window wins — never a
+    double-counting sum), ``host_rss_delta`` takes the latest whole-job
+    figure, and per-family ``fit_resources`` entries accumulate
+    (compile sums across a family's phases, peak maxes). No-op outside
+    a managed job."""
+    rec = _job_record.get()
+    if rec is None:
+        return
+    with _profile_lock:
+        prof = dict(rec.profile)
+        if peak_hbm_bytes is not None:
+            prof["peak_hbm_bytes"] = max(
+                int(peak_hbm_bytes), int(prof.get("peak_hbm_bytes", 0)))
+        if compile_s is not None:
+            prof["compile_s"] = round(
+                max(float(compile_s), float(prof.get("compile_s", 0.0))), 6)
+        if host_rss_delta is not None:
+            prof["host_rss_delta"] = int(host_rss_delta)
+        if family is not None and family_stats:
+            fr = dict(prof.get("fit_resources", {}))
+            ent = dict(fr.get(family, {"compile_s": 0.0,
+                                       "peak_hbm_bytes": 0}))
+            ent["compile_s"] = round(
+                float(ent.get("compile_s", 0.0))
+                + float(family_stats.get("compile_s", 0.0)), 6)
+            ent["peak_hbm_bytes"] = max(
+                int(ent.get("peak_hbm_bytes", 0)),
+                int(family_stats.get("peak_hbm_bytes", 0)))
+            fr[family] = ent
+            prof["fit_resources"] = fr
+        rec.profile = prof
 
 #: Error prefixes marking a job killed by INFRASTRUCTURE — a pod worker
 #: death (watchdog flag, parallel/spmd.py) or a process restart mid-job
@@ -181,14 +255,20 @@ class JobManager:
                 # (design.build, fit.*, journal.commit, worker-process
                 # spans over the SPMD channel) nests under it; a raise
                 # marks the span status=error before the handling below.
+                # resources.job_phase is the resource-sampling seam:
+                # every managed job's profile carries peak_hbm_bytes /
+                # compile_s / host_rss_delta, refined mid-job by the
+                # builder's per-phase samples (utils/resources.py).
                 from learningorchestra_tpu import config
+                from learningorchestra_tpu.utils import resources
 
                 with tracing.job_trace(
                         f"job.{kind}", trace_id=rec.trace_id,
                         parent=parent_ctx,
                         attrs={"kind": kind, "dataset": rec.dataset,
                                "job_id": rec.job_id,
-                               "mesh_epoch": config.mesh_epoch()}):
+                               "mesh_epoch": config.mesh_epoch()}), \
+                        resources.job_phase():
                     fn()
                 rec.status = "done"
             except PodDegraded as exc:

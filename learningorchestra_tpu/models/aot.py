@@ -39,6 +39,7 @@ Design points:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -46,6 +47,7 @@ import numpy as np
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.models.persistence import ModelRegistry
 from learningorchestra_tpu.models.registry import ONLINE_KINDS
+from learningorchestra_tpu.utils import resources
 
 
 def predict_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -262,9 +264,21 @@ class AotModel:
         x_specs = {
             b: jax.ShapeDtypeStruct((b, self.n_features), jnp.float32)
             for b in self.buckets}
+        # The whole bucket ladder is a compile site the resource plane
+        # accounts: wall time of the ladder build plus the XLA
+        # backend-compile seconds the monitoring listener attributes to
+        # this window (lo_compile_* on /metrics; docs/observability.md).
+        resources.ensure_listener()
+        c0 = resources.compile_seconds()
+        t0 = time.monotonic()
         self._programs = {
             b: jitted.lower(self._params, x_specs[b]).compile()
             for b in self.buckets}
+        #: Wall seconds this model's ladder took to build, and the XLA
+        #: backend-compile share of it — surfaced per load on the AOT
+        #: cache snapshot so a hot-swap's recompile cost is attributable.
+        self.compile_wall_s = round(time.monotonic() - t0, 6)
+        self.compile_s = round(resources.compile_seconds() - c0, 6)
 
     def predict_padded(self, X: np.ndarray) -> np.ndarray:
         """One device dispatch for a host batch of ≤ max-bucket rows:
@@ -310,6 +324,8 @@ class AotCache:
         self._name_locks: Dict[str, threading.Lock] = {}
         self._compiles = 0
         self._evictions = 0
+        self._hits = 0
+        self._compile_s = 0.0
 
     def entry(self, name: str) -> AotModel:
         """The loaded+compiled model, (re)built when absent or stale.
@@ -326,8 +342,19 @@ class AotCache:
         with self._lock:
             ent = self._models.get(name)
             if ent is not None and ent.version == version:
-                return ent
-            name_lock = self._name_locks.setdefault(name, threading.Lock())
+                self._hits += 1
+                hit = True
+            else:
+                hit = False
+                name_lock = self._name_locks.setdefault(
+                    name, threading.Lock())
+        if hit:
+            # Counted outside the cache lock: a compile-cache hit per
+            # served request is the hit leg of lo_compile_* — the miss
+            # leg (real backend compiles) comes from the monitoring
+            # listener (utils/resources.py).
+            resources.note_cache_hit()
+            return ent
         with name_lock:
             # Re-read the token under the name lock: a save() completing
             # while we waited means load() below returns the NEW content
@@ -369,6 +396,8 @@ class AotCache:
                     self._evictions += 1
                 self._models[name] = ent
                 self._compiles += len(self.buckets)
+                self._compile_s = round(
+                    self._compile_s + ent.compile_s, 6)
             return ent
 
     def invalidate(self, name: Optional[str] = None) -> None:
@@ -383,5 +412,7 @@ class AotCache:
         with self._lock:
             return {"models_loaded": len(self._models),
                     "programs_compiled": self._compiles,
+                    "compile_s": round(self._compile_s, 6),
+                    "hits": self._hits,
                     "evictions": self._evictions,
                     "buckets": list(self.buckets)}

@@ -58,7 +58,7 @@ from learningorchestra_tpu.models.registry import get_trainer
 from learningorchestra_tpu.ops import preprocess
 from learningorchestra_tpu.parallel import spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
-from learningorchestra_tpu.utils import tracing
+from learningorchestra_tpu.utils import resources, tracing
 from learningorchestra_tpu.utils.profiling import (
     device_span, device_trace, op_timer, timed)
 
@@ -323,15 +323,20 @@ class ModelBuilder:
         n_dev = int(np.prod(list(self.runtime.mesh.shape.values())))
         gate = threading.BoundedSemaphore(
             max(1, int(self.cfg.max_concurrent_fits)) if n_dev == 1 else 1)
-        # Pool threads carry no ambient trace — re-attach the build's
-        # context so each family's spans nest under the job/request span
+        # Pool threads carry no ambient trace OR job record — re-attach
+        # both so each family's spans nest under the job/request span
         # (the Gantt view of the PR-3 overlap: fit.<c> spans overlap in
         # wall time; their host_prep/device/finish children show which
-        # phase overlapped which).
+        # phase overlapped which) and its resource watermarks
+        # (family_phase, device_span) land on the right job's profile.
+        from learningorchestra_tpu import jobs
+
         parent_ctx = tracing.current()
+        job_rec = jobs.current_job_record()
 
         def fit_guarded(c: str) -> FitReport:
-            with tracing.attach(parent_ctx):
+            with tracing.attach(parent_ctx), \
+                    jobs.attach_job_record(job_rec):
                 try:
                     # The except sits OUTSIDE the span: a failing family
                     # must escape it so the fit.<c> span records
@@ -341,7 +346,17 @@ class ModelBuilder:
                         extra, prep_s = prep_fit(c)   # outside the gate
                         tracing.record_span(f"fit.{c}.host_prep", prep_s)
                         with gate:                    # device phase
-                            with Timer() as td:
+                            # family_phase attributes the fit program's
+                            # compile seconds to this family; the
+                            # probability pass's compiles land via
+                            # collect_fit's device_span. The compile
+                            # counter is process-global, so resources.
+                            # device_phase attributes a window's delta
+                            # only when no other phase overlapped it
+                            # (a gate >1 admits concurrent families) —
+                            # overlapped windows record peaks only,
+                            # never a double-counted compile_s.
+                            with Timer() as td, resources.family_phase(c):
                                 model = dispatch_fit(c, extra)
                             pre_s = prep_s + td.elapsed
                             probs, device_s = collect_fit(c, model, pre_s)
@@ -408,11 +423,17 @@ class ModelBuilder:
                 try:
                     extra, prep_s = prep_fit(c)
                     tracing.record_span(f"fit.{c}.host_prep", prep_s)
-                    model = dispatch_fit(c, extra)
-                    # No-op on TPU (stream order keeps back-to-back
-                    # programs aligned); fences the CPU test rig, whose
-                    # in-flight programs execute concurrently.
-                    spmd.serialize_collectives(model.params)
+                    # Same compile-attribution split as the pipelined
+                    # path: fit-program compiles here, the probability
+                    # pass's via collect_fit's device_span. This loop is
+                    # sequential, so these windows never overlap and
+                    # always attribute.
+                    with resources.family_phase(c):
+                        model = dispatch_fit(c, extra)
+                        # No-op on TPU (stream order keeps back-to-back
+                        # programs aligned); fences the CPU test rig,
+                        # whose in-flight programs execute concurrently.
+                        spmd.serialize_collectives(model.params)
                     fitted[c] = (model, time.time() - t0, t0)
                 except Exception as exc:  # noqa: BLE001 — per-model boundary
                     fitted[c] = exc

@@ -39,7 +39,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from learningorchestra_tpu import config as _config
-from learningorchestra_tpu.utils import failpoints, tracing
+from learningorchestra_tpu.utils import failpoints, resources, tracing
 from learningorchestra_tpu.utils.structlog import get_logger
 
 log = get_logger("spmd")
@@ -219,6 +219,10 @@ class _JobChannel:
         except OSError:
             _close_quietly(sock)
             return
+        # The hello's lite resource snapshot seeds /cluster's pod view
+        # before this worker has run a single job.
+        resources.note_remote(hello.get("process"),
+                              hello.get("resources"))
         with self._lock:
             self._conns.append(conn)
 
@@ -270,8 +274,14 @@ class _JobChannel:
                 # post-job drain timed out on: merge it late rather than
                 # dropping it — and never mistake it for this round's
                 # ack (it carries the OLD round id, but defense in
-                # depth beats a coincidence).
+                # depth beats a coincidence). The piggybacked resource
+                # snapshot still freshens the /cluster pod view; the
+                # job it belonged to is long gone, so its watermarks
+                # are NOT merged into whatever job is dispatching now.
                 tracing.ingest(ack.get("spans") or [])
+                res = ack.get("resources") or {}
+                resources.note_remote(res.get("process"),
+                                      res.get("snapshot"))
                 continue
             if ack.get("round") == rnd:
                 return "ok", ack
@@ -338,8 +348,13 @@ class _JobChannel:
         after the coordinator's own device ops complete — the workers
         ran the same collective program, so their shipments are
         imminent; the timeout bounds a wedged/slow worker (its spans
-        then merge at the next round's ack read instead). Returns how
-        many workers' spans merged."""
+        then merge at the next round's ack read instead). Each shipment
+        also carries the worker's resource watermarks for the job and a
+        lite process snapshot: the watermarks merge into the CURRENT
+        job's profile (this runs inside the job's body on the
+        coordinator, so ``peak_hbm_bytes`` becomes a pod-wide max) and
+        the snapshot freshens ``GET /cluster``. Returns how many
+        workers' shipments merged."""
         merged = 0
         for conn in self._live():
             deadline = time.time() + timeout_s
@@ -354,6 +369,20 @@ class _JobChannel:
                     continue
                 if msg.get("op") == "spans":
                     tracing.ingest(msg.get("spans") or [])
+                    res = msg.get("resources") or {}
+                    resources.note_remote(res.get("process"),
+                                          res.get("snapshot"))
+                    from learningorchestra_tpu import jobs
+
+                    wm = res.get("watermarks") or {}
+                    if isinstance(wm, dict) and (
+                            wm.get("peak_hbm_bytes")
+                            or wm.get("compile_s")):
+                        jobs.record_job_watermarks(
+                            peak_hbm_bytes=int(
+                                wm.get("peak_hbm_bytes") or 0) or None,
+                            compile_s=float(
+                                wm.get("compile_s") or 0.0) or None)
                     if msg.get("round") == rnd:
                         merged += 1
                         break
@@ -559,13 +588,14 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
         finally:
             stop.set()
             monitor.join(timeout=2.0)
-        # Merge the workers' spans for this job (they ship them
-        # unprompted once their device ops finish). Runs only when the
-        # device ops completed (an aborted round's workers never ran, so
-        # waiting on their shipment would just burn the timeout), only
-        # when this job is actually traced, and never on a degraded pod.
-        ctx = tracing.current()
-        if ctx is not None and ctx.sampled and pod_error() is None:
+        # Merge the workers' spans + resource watermarks for this job
+        # (they ship them unprompted once their device ops finish —
+        # always, even untraced: the job profile's pod-wide
+        # peak_hbm_bytes must not depend on the sampling decision).
+        # Runs only when the device ops completed (an aborted round's
+        # workers never ran, so waiting on their shipment would just
+        # burn the timeout) and never on a degraded pod.
+        if pod_error() is None:
             channel = _get_channel()
             with channel._lock:
                 rnd = channel._round
@@ -849,9 +879,12 @@ def worker_loop(store, runtime) -> str:
 
     # Epoch handshake: identify this incarnation before taking a worker
     # slot; the controller rejects a stale epoch (supervisor restarted the
-    # pod since this process started).
+    # pod since this process started). The hello carries a lite resource
+    # snapshot so /cluster shows this worker's host/device state from
+    # the moment it joins, not only after its first job.
     if not reply({"op": "hello", "epoch": epoch,
-                  "process": jax.process_index()}):
+                  "process": jax.process_index(),
+                  "resources": resources.process_snapshot(lite=True)}):
         log.info("controller lost during handshake; exiting")
         return "controller-lost"
     status, line = conn.recv_line(60.0)
@@ -914,6 +947,8 @@ def worker_loop(store, runtime) -> str:
             return "controller-lost"
         verdict = json.loads(line).get("op")
         if verdict == "go" and device_ops is not None:
+            resources.ensure_listener()
+            c0 = resources.compile_seconds()
             try:
                 with tracing.attach(wctx), \
                         tracing.span("dispatch.device", op=op), \
@@ -921,13 +956,23 @@ def worker_loop(store, runtime) -> str:
                     device_ops()
             except Exception:  # noqa: BLE001 — keep the loop alive
                 log.exception("worker device ops for %r failed", op)
-            if wctx is not None and wctx.sampled:
-                # Ship this job's spans to the coordinator (it drains
-                # them right after its own device ops; a missed drain
-                # merges at the next round's ack read). Failure to send
-                # = controller gone, caught at the next recv.
-                reply({"op": "spans", "round": rnd,
-                       "spans": tracing.pop_spans(wctx.trace_id)})
+            # Ship this job's spans + this process's resource watermarks
+            # to the coordinator (it drains them right after its own
+            # device ops; a missed drain merges at the next round's ack
+            # read). Always sent — the coordinator's job profile needs
+            # the pod-wide peak even for unsampled traces; spans ride
+            # along only when the trace recorded any. Failure to send =
+            # controller gone, caught at the next recv.
+            reply({"op": "spans", "round": rnd,
+                   "spans": (tracing.pop_spans(wctx.trace_id)
+                             if wctx is not None and wctx.sampled else []),
+                   "resources": {
+                       "process": jax.process_index(),
+                       "snapshot": resources.process_snapshot(lite=True),
+                       "watermarks": {
+                           "peak_hbm_bytes": resources.hbm_bytes_in_use(),
+                           "compile_s": round(
+                               resources.compile_seconds() - c0, 6)}}})
         elif verdict == "shutdown":
             return "shutdown"
 

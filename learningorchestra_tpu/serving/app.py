@@ -18,6 +18,8 @@ the upgrade that failed jobs set ``error`` and still flip ``finished``.
 from __future__ import annotations
 
 import json
+import os
+import time
 from typing import Optional
 
 from learningorchestra_tpu.catalog.dataset import ChunkCorrupt
@@ -38,7 +40,7 @@ from learningorchestra_tpu.serving.batcher import (
 from learningorchestra_tpu.serving.http import (
     FileResponse, HtmlResponse, HttpError, IdempotencyCache, Router,
     Server, TextResponse)
-from learningorchestra_tpu.utils import tracing
+from learningorchestra_tpu.utils import alerts, resources, tracing
 from learningorchestra_tpu.utils.structlog import get_logger
 from learningorchestra_tpu.viz.service import (
     ImageExists, ImageNotFound, ImageService, create_embedding_image)
@@ -76,6 +78,11 @@ class App:
         #: Idempotency-Key (the client SDK sends one per logical create)
         #: returns the first attempt's outcome instead of a spurious 409.
         self.idempotency = IdempotencyCache()
+        #: The SLO alert engine (utils/alerts.py), evaluated over the
+        #: same registry snapshot both /metrics formats render — reads
+        #: of /metrics, /alerts, /healthz and the status page drive its
+        #: evaluation windows (the Prometheus scrape-window model).
+        self.alerts = alerts.default_engine(self.cfg)
         self.router = Router()
         self._register()
         if recover and self.cfg.persist:
@@ -386,6 +393,16 @@ class App:
             info["pod_error"] = spmd.pod_error()
             info["healthy"] = info["pod_error"] is None
             info["restarts"] = config.restart_count()
+            # Per-process resource snapshots: this process sampled live,
+            # workers from their last job-channel shipment — so a
+            # multi-process pod's host RSS / device HBM is comparable at
+            # a glance (lite form: no per-dataset disk walk).
+            info["resources"] = {
+                str(info["process_index"]):
+                    resources.process_snapshot(app.cfg, lite=True),
+                **{str(k): v
+                   for k, v in resources.remote_snapshots().items()},
+            }
             return 200, info
 
         @self._route("GET", "/jobs")
@@ -404,9 +421,14 @@ class App:
             info["mesh"] = dict(app.runtime.mesh.shape)
             info["mesh_epoch"] = spmd.mesh_epoch()
             info["pod_error"] = spmd.pod_error()
+            # The page's 5 s auto-refresh doubles as the alert engine's
+            # heartbeat on watched deployments (_metrics_doc evaluates).
+            mdoc = app._metrics_doc()
             return 200, HtmlResponse(render_status(
                 info, app.jobs.records(), app.store.metadata_docs(),
-                serving=app.predictor.snapshot()))
+                serving=mdoc.get("serving"),
+                alerts=mdoc.get("alerts"),
+                resources=mdoc.get("resources")))
 
         @self._route("GET", "/metrics")
         def metrics(req):
@@ -440,22 +462,111 @@ class App:
                     "existed)")
             return 200, tree
 
+        # ---- resource & capacity plane (utils/resources.py, /alerts.py)
+        @self._route("GET", "/resources")
+        def resources_view(_req):
+            # Per-device HBM + host + disk + compile accounting for THIS
+            # process, plus last-known worker snapshots on a pod.
+            doc = resources.process_snapshot(app.cfg)
+            workers = resources.remote_snapshots()
+            if workers:
+                doc["workers"] = {str(k): v for k, v in workers.items()}
+            return 200, doc
+
+        @self._route("GET", "/alerts")
+        def alerts_view(_req):
+            # Reading /alerts advances an evaluation window like every
+            # other registry read — an operator polling this page IS the
+            # alert engine's clock.
+            app._metrics_doc()
+            return 200, app.alerts.snapshot()
+
+        @self._route("GET", "/healthz")
+        def healthz(_req):
+            doc = app._health_doc()
+            return (200 if doc["healthy"] else 503), doc
+
+        @self._route("POST", "/debug/profile")
+        def debug_profile(req):
+            # Knob-gated (LO_TPU_DEBUG_PROFILE): profiling costs real
+            # overhead and writes operator-readable traces to disk, so
+            # it is an explicit opt-in → 403 otherwise.
+            if not app.cfg.debug_profile:
+                raise PermissionError(
+                    "on-demand profiling is disabled; set "
+                    "LO_TPU_DEBUG_PROFILE=1 to enable POST /debug/profile")
+            try:
+                seconds = float(req.body.get("seconds", 2.0))
+            except (TypeError, ValueError):
+                raise ValueError("seconds must be a number") from None
+            if seconds <= 0 or seconds > resources.PROFILE_MAX_SECONDS:
+                raise ValueError(
+                    f"seconds must be in (0, "
+                    f"{resources.PROFILE_MAX_SECONDS:.0f}]")
+            out_dir = os.path.join(
+                app.cfg.store_root, "_profiles",
+                time.strftime("%Y%m%d-%H%M%S"))
+            rec = app.jobs.submit(
+                "debug_profile", [],
+                lambda: resources.capture_profile(out_dir, seconds))
+            return 201, {"result": "profile capture started",
+                         "dir": out_dir, "seconds": seconds,
+                         "job_id": rec.job_id}
+
     def _metrics_doc(self) -> dict:
         """The one metrics registry snapshot both /metrics formats render
-        (JSON as-is; ?format=prometheus through utils/prometheus)."""
+        (JSON as-is; ?format=prometheus through utils/prometheus). The
+        alert engine evaluates over this exact snapshot — window-gated,
+        so scrape cadence is evaluation cadence — and its state rides
+        back in the same document, so an alert can never fire on a
+        number the operator cannot see."""
         from learningorchestra_tpu.catalog import readpipe
         from learningorchestra_tpu.utils.profiling import op_timer
 
         by_status: dict = {}
         for r in self.jobs.records():
             by_status[r["status"]] = by_status.get(r["status"], 0) + 1
-        return {"ops": op_timer.snapshot(),
-                "jobs": by_status,
-                "integrity": self.store.integrity_snapshot(),
-                "read_pipeline": readpipe.snapshot(),
-                "serving": self.predictor.snapshot(),
-                "tracing": tracing.counters_snapshot(),
-                "profile_dir": self.cfg.profile_dir or None}
+        pod_error = spmd.pod_error()
+        doc = {"ops": op_timer.snapshot(),
+               "jobs": by_status,
+               "integrity": self.store.integrity_snapshot(),
+               "read_pipeline": readpipe.snapshot(),
+               "serving": self.predictor.snapshot(),
+               "tracing": tracing.counters_snapshot(),
+               "resources": resources.process_snapshot(self.cfg),
+               "compile": resources.compile_snapshot(),
+               "pod": {"error": pod_error,
+                       "degraded": pod_error is not None},
+               "profile_dir": self.cfg.profile_dir or None}
+        self.alerts.observe(doc)
+        doc["alerts"] = self.alerts.snapshot()
+        return doc
+
+    def _health_doc(self) -> dict:
+        """The deep ``GET /healthz`` rollup: pod health, disk headroom,
+        predict-dispatcher liveness, and the alert summary — 200 when
+        every check passes and no critical alert fires, 503 (with this
+        same JSON detail) otherwise."""
+        mdoc = self._metrics_doc()
+        disk = (mdoc.get("resources") or {}).get("disk") or {}
+        watermark = int(self.cfg.disk_free_watermark_mb) * (1 << 20)
+        free = disk.get("free_bytes")
+        disk_ok = (watermark <= 0 or free is None or free >= watermark)
+        dispatchers = self.predictor.health()
+        pod_error = (mdoc.get("pod") or {}).get("error")
+        firing = self.alerts.firing()
+        critical = self.alerts.firing(severity="critical")
+        checks = {
+            "pod": {"ok": pod_error is None, "error": pod_error},
+            "disk": {"ok": disk_ok, "free_bytes": free,
+                     "watermark_bytes": watermark},
+            "dispatchers": dispatchers,
+            "alerts": {"ok": not critical, "firing": firing,
+                       "critical": critical},
+        }
+        return {"healthy": all(c["ok"] for c in checks.values()),
+                "checks": checks,
+                "mesh_epoch": spmd.mesh_epoch()}
 
     def _register_images(self, method: str) -> None:
         app = self

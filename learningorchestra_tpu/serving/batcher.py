@@ -266,6 +266,16 @@ class ModelBatcher:
         with self._cond:
             return self._queue_rows
 
+    def thread_alive(self) -> bool:
+        """Liveness probe for the health rollup: True while the
+        dispatcher thread runs OR it was stopped deliberately — only a
+        dead-but-not-stopped thread (the PR 6 silent-death class the
+        thread sanitizer hunts) reads as unhealthy."""
+        with self._cond:
+            if self._stopped:
+                return True
+        return self._thread.is_alive()
+
     # -- worker side ---------------------------------------------------------
 
     def _take_batch(self) -> List[_Pending]:
@@ -516,6 +526,18 @@ class PredictBatcher:
                 doomed = [b] if b is not None else []
         for b in doomed:
             b.stop()
+
+    def health(self) -> Dict[str, Any]:
+        """Dispatcher-thread liveness for ``GET /healthz``: a model whose
+        dispatcher thread died without being stopped would black-hole
+        its requests — the silent failure mode the deep health rollup
+        exists to surface."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        dead = sorted(n for n, b in batchers.items()
+                      if not b.thread_alive())
+        return {"ok": not dead, "dispatchers": len(batchers),
+                "dead": dead}
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

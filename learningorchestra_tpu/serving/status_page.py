@@ -32,7 +32,19 @@ th { background: #eceff6; }
 """
 
 _STATUS_CLASS = {"done": "done", "failed": "failed",
-                 "running": "running", "queued": "queued"}
+                 "running": "running", "queued": "queued",
+                 "firing": "failed", "ok": "done"}
+
+
+def _fmt_bytes(n: Any) -> str:
+    if not isinstance(n, (int, float)):
+        return ""
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return ""
 
 
 def _badge(status: str) -> str:
@@ -75,10 +87,61 @@ def _serving_section(serving: Optional[Dict[str, Any]]) -> str:
             f"<p>{agg}</p>{table}")
 
 
+def _alerts_section(alerts: Optional[Dict[str, Any]]) -> str:
+    """The SLO panel: every rule with its state, so 'is the service
+    healthy against its SLOs' is answerable without curling /alerts."""
+    if not alerts or not alerts.get("rules"):
+        return ""
+    firing = alerts.get("firing") or []
+    rows = []
+    for name, r in sorted(alerts["rules"].items()):
+        rows.append([
+            escape(str(name)),
+            escape(str(r.get("severity", ""))),
+            _badge("firing" if r.get("firing") else "ok"),
+            escape("" if r.get("value") is None
+                   else f"{r['value']:.6g}"),
+            escape(f"{r.get('op', '>')} {r.get('threshold'):.6g}"),
+            escape(str(r.get("fired_count", 0))),
+        ])
+    head = (f'<p><span class="kv"><b>firing</b> '
+            f'{escape(", ".join(firing) or "none")}</span></p>')
+    return (f"<h2>Alerts ({len(firing)} firing)</h2>{head}"
+            + _table(["rule", "severity", "state", "value", "threshold",
+                      "times fired"], rows))
+
+
+def _resources_section(res: Optional[Dict[str, Any]]) -> str:
+    """One line of capacity vitals: host RSS/fds, device bytes, disk
+    headroom, compile totals — the /resources snapshot at a glance."""
+    if not res:
+        return ""
+    host = res.get("host") or {}
+    dev = res.get("devices") or {}
+    disk = res.get("disk") or {}
+    comp = res.get("compile") or {}
+    kvs = [
+        ("host rss", _fmt_bytes(host.get("rss_bytes"))),
+        ("open fds", host.get("open_fds")),
+        ("device bytes", _fmt_bytes(dev.get("total_bytes_in_use"))),
+        ("device source", dev.get("source")),
+        ("store", _fmt_bytes(disk.get("store_bytes"))),
+        ("disk free", _fmt_bytes(disk.get("free_bytes"))),
+        ("compiles", comp.get("compiles")),
+        ("compile s", comp.get("compile_s")),
+    ]
+    line = "".join(
+        f'<span class="kv"><b>{escape(str(k))}</b> {escape(str(v))}</span>'
+        for k, v in kvs if v not in (None, ""))
+    return f"<h2>Resources</h2><p>{line}</p>"
+
+
 def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
                   datasets: List[Dict[str, Any]],
                   refresh_seconds: int = 5,
-                  serving: Optional[Dict[str, Any]] = None) -> str:
+                  serving: Optional[Dict[str, Any]] = None,
+                  alerts: Optional[Dict[str, Any]] = None,
+                  resources: Optional[Dict[str, Any]] = None) -> str:
     """Render the operator page. Inputs are exactly what the JSON routes
     return, so the page can never disagree with the API."""
     mesh = cluster.get("mesh") or {}
@@ -121,6 +184,8 @@ def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
 <body>
 <h1>learningorchestra-tpu — cluster status</h1>
 <p>{cluster_kvs}<span class="kv"><b>mesh</b> {mesh_txt}</span></p>
+{_alerts_section(alerts)}
+{_resources_section(resources)}
 {_serving_section(serving)}
 <h2>Jobs ({len(jobs)})</h2>
 {_table(["job", "kind", "target datasets", "status", "runtime (s)",
@@ -131,6 +196,9 @@ def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
 {refresh_seconds}s — JSON at <a href="/cluster">/cluster</a>,
 <a href="/jobs">/jobs</a>, <a href="/files">/files</a>,
 <a href="/metrics">/metrics</a>,
-<a href="/traces">/traces</a>; Prometheus at
+<a href="/traces">/traces</a>,
+<a href="/resources">/resources</a>,
+<a href="/alerts">/alerts</a>,
+<a href="/healthz">/healthz</a>; Prometheus at
 <a href="/metrics?format=prometheus">/metrics?format=prometheus</a></p>
 </body></html>"""

@@ -155,12 +155,28 @@ def device_span(fn, name: Optional[str] = None):
     the exact same measured duration — the builder passes
     ``fit.<family>.device`` so a job's trace and its ``fit_device_s``
     profile figure agree to the digit.
+
+    Every device phase is also a resource sample point
+    (``resources.device_phase``): the compile-seconds delta across the
+    span (attributed only when the window overlapped no other phase —
+    the counter is process-global) and a device-bytes reading at its
+    end merge into the current job's watermarks (``peak_hbm_bytes``)
+    and — for ``fit.<family>.device`` names — the per-family table
+    bench.py and the job profile's ``fit_resources`` read. Best-effort:
+    a sampling failure degrades to an unprofiled span, never a failed
+    fit.
     """
     import jax
 
-    t0 = time.time()
-    out = jax.block_until_ready(fn())
-    dur = time.time() - t0
+    from learningorchestra_tpu.utils import resources
+
+    with resources.device_phase(name):
+        # Timed INSIDE the sampling window so the measured duration
+        # stays the pure dispatch-to-completion figure (the sampling
+        # reads at window exit never inflate device_s).
+        t0 = time.time()
+        out = jax.block_until_ready(fn())
+        dur = time.time() - t0
     if name is not None:
         tracing.record_span(name, dur)
     return out, dur

@@ -19,7 +19,15 @@ Mapping conventions:
   live gauges (``queue_rows``, ``qps``), and the request-latency
   histogram ``lo_serving_latency_seconds{model=...}`` — the log-bucketed
   histogram that replaced the old rolling-sample p50/p99 (the JSON
-  view's ``p50_ms``/``p99_ms`` are estimated from the same buckets).
+  view's ``p50_ms``/``p99_ms`` are estimated from the same buckets);
+- ``resources`` → ``lo_resource_*`` gauges: host RSS/fds/threads,
+  per-device HBM (``{device=...}`` where the backend reports it, plus
+  process totals), and chunk-store disk usage/free (``{root=...}``);
+- ``compile`` → ``lo_compile_*`` counters (backend compiles = cache
+  misses, cumulative compile seconds, cache hits);
+- ``alerts`` → ``lo_alert_firing{alert=...}`` 0/1 gauges with
+  ``lo_alert_value``/``lo_alert_threshold`` next to them, plus engine
+  counters; ``pod`` → ``lo_pod_degraded``.
 """
 
 from __future__ import annotations
@@ -162,5 +170,76 @@ def render(doc: Dict[str, Any]) -> str:
     if aot:
         _flat_counters(w, "lo_serving_aot", aot, _COUNTER,
                        "AOT predict-program cache counter")
+
+    res = doc.get("resources") or {}
+    host = res.get("host") or {}
+    if host:
+        _flat_counters(w, "lo_resource_host", host, _GAUGE,
+                       "Host process resource gauge")
+    devices = res.get("devices") or {}
+    if devices:
+        for key in ("total_bytes_in_use", "peak_bytes_in_use"):
+            val = devices.get(key)
+            if isinstance(val, (int, float)):
+                name = f"lo_resource_device_{key}"
+                w.header(name, _GAUGE,
+                         f"Device memory across local devices ({key})")
+                w.sample(name, None, val)
+        for dev in devices.get("devices") or []:
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                val = dev.get(key)
+                if isinstance(val, (int, float)):
+                    name = f"lo_resource_device_{key}_by_device"
+                    w.header(name, _GAUGE,
+                             f"Per-device memory gauge ({key})")
+                    w.sample(name, {"device": dev.get("id", "?")}, val)
+    disk = res.get("disk") or {}
+    if disk:
+        for key in ("total_bytes", "free_bytes", "used_bytes",
+                    "store_bytes"):
+            val = disk.get(key)
+            if isinstance(val, (int, float)):
+                name = f"lo_resource_disk_{key}"
+                w.header(name, _GAUGE,
+                         f"Chunk-store filesystem gauge ({key})")
+                w.sample(name, {"root": disk.get("root", "?")}, val)
+
+    comp = doc.get("compile") or {}
+    if comp:
+        _flat_counters(w, "lo_compile", comp, _COUNTER,
+                       "XLA compile accounting counter")
+
+    pod = doc.get("pod") or {}
+    if pod:
+        w.header("lo_pod_degraded", _GAUGE,
+                 "1 while the pod is degraded (worker death pending "
+                 "supervisor restart)")
+        w.sample("lo_pod_degraded", None,
+                 1 if pod.get("degraded") else 0)
+
+    al = doc.get("alerts") or {}
+    rules = al.get("rules") or {}
+    if rules:
+        w.header("lo_alert_firing", _GAUGE,
+                 "1 while the named alert rule is firing")
+        for name, r in sorted(rules.items()):
+            w.sample("lo_alert_firing", {"alert": name},
+                     1 if r.get("firing") else 0)
+        w.header("lo_alert_value", _GAUGE,
+                 "Last evaluated value of the named alert rule")
+        for name, r in sorted(rules.items()):
+            if isinstance(r.get("value"), (int, float)):
+                w.sample("lo_alert_value", {"alert": name}, r["value"])
+        w.header("lo_alert_threshold", _GAUGE,
+                 "Configured threshold of the named alert rule")
+        for name, r in sorted(rules.items()):
+            w.sample("lo_alert_threshold", {"alert": name},
+                     r.get("threshold", 0))
+        _flat_counters(
+            w, "lo_alert", {k: al[k] for k in
+                            ("evaluations", "fired_total",
+                             "resolved_total") if k in al},
+            _COUNTER, "Alert engine counter")
 
     return w.text()

@@ -271,6 +271,33 @@ def test_elastic_recovery_supervised_restart(tmp_path):
         assert info["healthy"] is True, info
         assert info["pod_error"] is None, info
         assert info["process_count"] == 2, info
+        # ISSUE 10: /cluster carries per-process resource snapshots —
+        # the coordinator live, the worker from its job-channel
+        # shipments — so a 2-process pod is comparable at a glance.
+        assert info["resources"]["0"]["host"]["rss_bytes"] > 0, info
+        assert "1" in info["resources"], info["resources"].keys()
+        assert info["resources"]["1"]["host"]["rss_bytes"] > 0, info
+        # The SPMD-dispatched retried build's job profile carries the
+        # resource watermarks (acceptance: including the dispatched
+        # path), with the worker's shipment folded into the pod max.
+        jobs_doc = requests.get(f"http://127.0.0.1:{http_port}/jobs",
+                                timeout=10).json()
+        done = [j for j in jobs_doc
+                if j["kind"].endswith("model_builder")
+                and j["status"] == "done"]
+        assert done, jobs_doc
+        prof = done[0].get("profile") or {}
+        assert prof.get("peak_hbm_bytes", 0) > 0, prof
+        assert "compile_s" in prof, prof
+        # The recovered pod's deep health rollup and resource snapshot.
+        hz = requests.get(f"http://127.0.0.1:{http_port}/healthz",
+                          timeout=10)
+        assert hz.status_code == 200, hz.text
+        assert hz.json()["healthy"] is True, hz.text
+        res = requests.get(f"http://127.0.0.1:{http_port}/resources",
+                           timeout=10).json()
+        assert res["host"]["rss_bytes"] > 0, res
+        assert res["disk"]["free_bytes"] > 0, res
     finally:
         sup.close()
         runner.join(timeout=15)
@@ -328,6 +355,17 @@ def test_elastic_recovery_survives_repeated_failures(tmp_path):
         info = requests.get(f"http://127.0.0.1:{http_port}/cluster",
                             timeout=10).json()
         assert info["mesh_epoch"] == 2 and info["healthy"], info
+        # ISSUE 10 (slow lane): after two restart loops, the deep health
+        # rollup and resource snapshot read clean on the final pod —
+        # the epoch-scoped poison from earlier incarnations must not
+        # leak into /healthz's pod check or the pod_degraded alert.
+        hz = requests.get(f"http://127.0.0.1:{http_port}/healthz",
+                          timeout=10)
+        assert hz.status_code == 200, hz.text
+        assert hz.json()["checks"]["pod"]["ok"], hz.text
+        res = requests.get(f"http://127.0.0.1:{http_port}/resources",
+                           timeout=10).json()
+        assert res["host"]["rss_bytes"] > 0, res
     finally:
         sup.close()
         runner.join(timeout=15)

@@ -1,0 +1,410 @@
+"""Resource & capacity plane (ISSUE 10): resource snapshots (HBM / host
+/ disk / compile accounting), job-profile watermarks on the sweep path,
+the SLO alert engine (fire/resolve hysteresis, snapshot isolation), the
+deep /healthz rollup flipping 200→503 under an injected fault, /cluster
+per-process snapshots, client passthroughs, and the knob-gated
+POST /debug/profile capture."""
+
+import copy
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu.client import Context, Observability
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.serving.app import App
+from learningorchestra_tpu.utils import alerts, resources
+
+
+@pytest.fixture(autouse=True)
+def _resources_isolation():
+    resources.reset()
+    yield
+    resources.reset()
+
+
+# -- resource snapshots -------------------------------------------------------
+
+def test_process_snapshot_smoke(tmp_path):
+    import jax
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    os.makedirs(cfg.store_root, exist_ok=True)
+    (tmp_path / "store" / "some_ds").mkdir()
+    (tmp_path / "store" / "some_ds" / "blob").write_bytes(b"x" * 4096)
+    snap = resources.process_snapshot(cfg)
+    assert snap["host"]["rss_bytes"] > 0
+    assert snap["host"]["open_fds"] > 0
+    assert snap["host"]["threads"] >= 1
+    devices = snap["devices"]
+    assert len(devices["devices"]) == jax.local_device_count()
+    assert devices["source"] in ("memory_stats", "live_buffers")
+    disk = snap["disk"]
+    assert disk["total_bytes"] > 0 and disk["free_bytes"] > 0
+    assert disk["datasets"]["some_ds"] == 4096
+    assert disk["store_bytes"] >= 4096
+    # Lite form (what workers ship / what /cluster shows) skips the walk.
+    lite = resources.process_snapshot(cfg, lite=True)
+    assert "disk" not in lite and lite["host"]["rss_bytes"] > 0
+
+
+def test_disk_snapshot_ttl_cache(tmp_path):
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    os.makedirs(cfg.store_root)
+    first = resources.disk_snapshot(cfg, ttl_s=60.0)
+    (tmp_path / "store" / "late_ds").mkdir()
+    (tmp_path / "store" / "late_ds" / "blob").write_bytes(b"y" * 128)
+    # Within the TTL the cached walk is served; after reset it refreshes.
+    assert "late_ds" not in resources.disk_snapshot(cfg,
+                                                   ttl_s=60.0)["datasets"]
+    resources.reset()
+    assert resources.disk_snapshot(cfg)["datasets"]["late_ds"] == 128
+    assert first["root"] == cfg.store_root
+
+
+def test_compile_accounting_counts_real_compiles_only():
+    import jax
+    import jax.numpy as jnp
+
+    assert resources.ensure_listener()
+    c0 = resources.compile_seconds()
+    n0 = resources.compile_snapshot()["compiles"]
+    f = jax.jit(lambda x: (x * 3.5 + 1.25).sum())
+    f(jnp.arange(101, dtype=jnp.float32)).block_until_ready()   # cold
+    c1 = resources.compile_seconds()
+    assert c1 > c0
+    assert resources.compile_snapshot()["compiles"] > n0
+    f(jnp.arange(101, dtype=jnp.float32)).block_until_ready()   # warm
+    assert resources.compile_seconds() == c1
+    snap = resources.compile_snapshot()
+    assert snap["cache_misses"] == snap["compiles"]
+
+
+def test_remote_snapshot_merge_rejects_garbage():
+    resources.note_remote(1, {"host": {"rss_bytes": 7}})
+    resources.note_remote("2", {"host": {"rss_bytes": 9}})
+    resources.note_remote("bogus", {"host": {}})     # dropped
+    resources.note_remote(3, "not-a-dict")           # dropped
+    remote = resources.remote_snapshots()
+    assert set(remote) == {1, 2}
+    assert remote[1]["host"]["rss_bytes"] == 7
+    assert remote[1]["at"] > 0
+
+
+# -- alert engine -------------------------------------------------------------
+
+def _gauge_rule(threshold=10.0, op=">", for_windows=None, name="g"):
+    return alerts.AlertRule(
+        name=name, severity="warning", summary="test gauge",
+        sample=lambda snap, state: snap.get("value"),
+        threshold=threshold, op=op, for_windows=for_windows)
+
+
+def test_alert_fire_resolve_hysteresis():
+    eng = alerts.AlertEngine([_gauge_rule()], window_s=0.0,
+                             for_windows=2, clear_windows=2)
+    assert eng.evaluate({"value": 99}) == []          # 1 bad window: armed
+    assert not eng.snapshot()["rules"]["g"]["firing"]
+    (t,) = eng.evaluate({"value": 99})                # 2nd: fires
+    assert t == {"alert": "g", "to": "firing", "value": 99,
+                 "threshold": 10.0}
+    assert eng.snapshot()["firing"] == ["g"]
+    assert eng.evaluate({"value": 1}) == []           # 1 clean: still firing
+    assert eng.snapshot()["rules"]["g"]["firing"]
+    (t,) = eng.evaluate({"value": 1})                 # 2nd clean: resolves
+    assert t["to"] == "resolved"
+    snap = eng.snapshot()
+    assert snap["firing"] == []
+    assert snap["rules"]["g"]["fired_count"] == 1
+    assert snap["fired_total"] == 1 and snap["resolved_total"] == 1
+
+
+def test_alert_flap_does_not_fire_below_for_windows():
+    eng = alerts.AlertEngine([_gauge_rule()], window_s=0.0,
+                             for_windows=2, clear_windows=1)
+    for _ in range(5):                         # bad, good, bad, good...
+        assert eng.evaluate({"value": 99}) == []
+        assert eng.evaluate({"value": 1}) == []
+    assert eng.snapshot()["firing"] == []
+
+
+def test_alert_missing_data_holds_streaks():
+    eng = alerts.AlertEngine([_gauge_rule()], window_s=0.0,
+                             for_windows=2, clear_windows=1)
+    eng.evaluate({"value": 99})
+    eng.evaluate({})                           # no data: streak holds
+    (t,) = eng.evaluate({"value": 99})         # 2nd bad window fires
+    assert t["to"] == "firing"
+
+
+def test_alert_window_gating():
+    eng = alerts.AlertEngine([_gauge_rule(for_windows=1)], window_s=100.0,
+                             for_windows=1, clear_windows=1)
+    assert len(eng.observe({"value": 99}, now=0.0)) == 1
+    # Gated out inside the window — no second evaluation.
+    assert eng.observe({"value": 99}, now=50.0) == []
+    assert eng.snapshot()["evaluations"] == 1
+    assert len(eng.observe({"value": 1}, now=150.0)) == 1   # resolves
+
+
+def test_alert_counter_delta_baseline_and_increment():
+    rule = alerts.AlertRule(
+        name="corrupt", severity="critical", summary="",
+        sample=alerts.counter_delta("integrity", "chunks_corrupt"),
+        threshold=0.0, for_windows=1)
+    eng = alerts.AlertEngine([rule], window_s=0.0, clear_windows=1)
+    # First observation of a nonzero counter is a baseline, not a fire
+    # (a restarted server must not re-page for historical corruption).
+    assert eng.evaluate({"integrity": {"chunks_corrupt": 5}}) == []
+    assert eng.evaluate({"integrity": {"chunks_corrupt": 5}}) == []
+    (t,) = eng.evaluate({"integrity": {"chunks_corrupt": 6}})
+    assert t["to"] == "firing" and t["value"] == 1.0
+    (t,) = eng.evaluate({"integrity": {"chunks_corrupt": 6}})
+    assert t["to"] == "resolved"
+
+
+def test_alert_engine_never_mutates_snapshot():
+    cfg = Settings()
+    eng = alerts.default_engine(cfg)
+    snap = {"serving": {"models": {"m": {"p99_ms": 1e9}},
+                        "rejected": 3, "requests": 10},
+            "integrity": {"chunks_corrupt": 1},
+            "read_pipeline": {"worker_errors": 0},
+            "resources": {"disk": {"free_bytes": 0}},
+            "pod": {"error": "worker died"}}
+    frozen = copy.deepcopy(snap)
+    eng.evaluate(snap)
+    eng.evaluate(snap)
+    assert snap == frozen, "rule evaluation mutated the registry snapshot"
+
+
+def test_alert_engine_state_is_per_instance():
+    cfg = Settings()
+    a, b = alerts.default_engine(cfg), alerts.default_engine(cfg)
+    bad = {"pod": {"error": "worker died"}}
+    a.evaluate(bad)
+    assert a.snapshot()["rules"]["pod_degraded"]["firing"]
+    assert not b.snapshot()["rules"]["pod_degraded"]["firing"]
+
+
+def test_default_rules_reject_rate_and_p99():
+    cfg = Settings()
+    cfg.slo_p99_ms = 100.0
+    cfg.slo_reject_rate = 0.25
+    eng = alerts.AlertEngine(alerts.default_rules(cfg), window_s=0.0,
+                             for_windows=1, clear_windows=1)
+    base = {"serving": {"models": {"m": {"p99_ms": 50.0, "qps": 2.0}},
+                        "rejected": 0, "requests": 0}}
+    eng.evaluate(base)                                      # baselines
+    fired = eng.evaluate({"serving": {
+        "models": {"m": {"p99_ms": 250.0, "qps": 2.0}},
+        "rejected": 30, "requests": 10}})
+    names = {t["alert"] for t in fired if t["to"] == "firing"}
+    assert names == {"serving_p99_slo", "serving_reject_rate"}
+    # An idle model's lifetime-histogram fallback must NOT keep the
+    # alert lit: qps 0 reads as no recent traffic ⇒ value 0.0 ⇒ resolve
+    # (the zero-delta window resolves the reject-rate rule too).
+    resolved = {t["alert"]: t for t in eng.evaluate({"serving": {
+        "models": {"m": {"p99_ms": 250.0, "qps": 0.0}},
+        "rejected": 30, "requests": 10}})}
+    assert resolved["serving_p99_slo"]["to"] == "resolved"
+    assert resolved["serving_p99_slo"]["value"] == 0.0
+    # 0-threshold knobs drop their rules entirely.
+    cfg2 = Settings()
+    cfg2.slo_p99_ms = 0.0
+    cfg2.slo_reject_rate = 0.0
+    cfg2.disk_free_watermark_mb = 0
+    names2 = {r.name for r in alerts.default_rules(cfg2)}
+    assert "serving_p99_slo" not in names2
+    assert "serving_reject_rate" not in names2
+    assert "disk_free_low" not in names2
+    assert {"pod_degraded", "integrity_corrupt",
+            "readpipe_worker_errors"} <= names2
+
+
+# -- live server: watermarks, healthz, cluster, client, debug profile --------
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    # Module-scoped: one App + server + compiled sweep shared by every
+    # live test below (per-test Apps would re-pay jax warmup each time).
+    # The corruption test rots ONLY the dedicated res_scrub dataset, so
+    # sharing is safe.
+    tmp = tmp_path_factory.mktemp("res_serve")
+    cfg = Settings()
+    cfg.store_root = str(tmp / "store")
+    cfg.image_root = str(tmp / "images")
+    cfg.port = 0
+    cfg.persist = True
+    cfg.alert_window_s = 0.0        # every registry read evaluates
+    cfg.alert_for_windows = 1
+    cfg.alert_clear_windows = 1
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(0)
+    n = 400
+    y = rng.integers(0, 2, n)
+    X = rng.normal(size=(n, 4)) + y[:, None]
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["label"] = y.astype(np.int64)
+    for name in ("res_train", "res_test", "res_scrub"):
+        app.store.create(name, columns={k: v.copy()
+                                        for k, v in cols.items()})
+        app.store.finish(name)
+    server = app.serve(background=True)
+    ctx = Context(f"http://127.0.0.1:{server.port}", poll_seconds=0.05,
+                  timeout=120)
+    yield ctx, app
+    server.stop()
+
+
+def test_sweep_job_profile_carries_watermarks(served):
+    """Acceptance: a completed sweep job's profile carries
+    ``peak_hbm_bytes`` and ``compile_s`` plus per-family
+    ``fit_resources`` — the cost inputs ROADMAP 5's packing needs."""
+    ctx, app = served
+    resp = requests.post(ctx.url("/models"), json={
+        "training_filename": "res_train", "test_filename": "res_test",
+        "prediction_filename": "res_pred",
+        "classificators_list": ["lr", "nb"], "label": "label",
+        "sync": False})
+    assert resp.status_code == 201, resp.text
+    app.jobs.wait_all(timeout=120)
+    (job,) = [j for j in requests.get(ctx.url("/jobs")).json()
+              if j["kind"] == "model_builder"]
+    assert job["status"] == "done"
+    prof = job["profile"]
+    assert prof["peak_hbm_bytes"] > 0
+    assert prof["compile_s"] >= 0.0
+    assert "host_rss_delta" in prof
+    for fam in ("lr", "nb"):
+        ent = prof["fit_resources"][fam]
+        assert ent["peak_hbm_bytes"] > 0
+        assert ent["compile_s"] >= 0.0
+
+
+def test_healthz_flips_on_injected_failpoint_corruption(served):
+    """Acceptance: /healthz 200 → 503 with a NAMED firing alert under an
+    injected fault — the ``catalog.chunk.pre_read`` bitflip failpoint
+    rots a committed chunk at its next verification, the scrub's CRC
+    mismatch bumps ``integrity.chunks_corrupt``, and the critical
+    ``integrity_corrupt`` rule degrades the rollup."""
+    from learningorchestra_tpu.utils import failpoints
+
+    ctx, app = served
+    hz = requests.get(ctx.url("/healthz"))
+    assert hz.status_code == 200 and hz.json()["healthy"]
+
+    failpoints.configure("catalog.chunk.pre_read=bitflip")
+    try:
+        scrub = requests.post(ctx.url("/catalog/scrub"),
+                              json={"dataset": "res_scrub"}).json()
+        assert scrub["errors"].get("res_scrub"), scrub
+    finally:
+        failpoints.reset()
+
+    hz = requests.get(ctx.url("/healthz"))
+    assert hz.status_code == 503, hz.text
+    doc = hz.json()
+    assert doc["healthy"] is False
+    assert "integrity_corrupt" in doc["checks"]["alerts"]["firing"]
+    assert "integrity_corrupt" in doc["checks"]["alerts"]["critical"]
+
+    # clear_windows=1: the next clean evaluation resolves it and health
+    # returns (no new corruption increments).
+    hz = requests.get(ctx.url("/healthz"))
+    assert hz.status_code == 200, hz.text
+
+
+def test_cluster_includes_process_resources(served):
+    ctx, _app = served
+    info = requests.get(ctx.url("/cluster")).json()
+    snap = info["resources"][str(info["process_index"])]
+    assert snap["host"]["rss_bytes"] > 0
+    assert snap["devices"]["source"] in ("memory_stats", "live_buffers")
+    assert "disk" not in snap     # lite form: no per-dataset walk
+
+
+def test_resources_endpoint_and_client_passthroughs(served):
+    ctx, _app = served
+    obs = Observability(ctx)
+    doc = obs.resources()
+    assert doc["host"]["rss_bytes"] > 0
+    assert doc["disk"]["free_bytes"] > 0
+    assert doc["compile"]["compiles"] >= 0
+    al = obs.alerts()
+    assert "rules" in al and "pod_degraded" in al["rules"]
+    hz = obs.healthz()
+    assert hz["healthy"] is True
+    assert set(hz["checks"]) == {"pod", "disk", "dispatchers", "alerts"}
+
+
+def test_client_healthz_degraded_names_alerts(tmp_path):
+    """503-from-healthz raises with the failing alert names in the
+    message (satellite #1): an impossible disk watermark fires
+    ``disk_free_low`` on the first evaluation."""
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.image_root = str(tmp_path / "images")
+    cfg.port = 0
+    cfg.persist = True
+    cfg.alert_window_s = 0.0
+    cfg.alert_for_windows = 1
+    cfg.alert_clear_windows = 1
+    cfg.disk_free_watermark_mb = 1 << 40     # nothing has 2^60 bytes free
+    app = App(cfg, recover=False)
+    server = app.serve(background=True)
+    try:
+        ctx = Context(f"http://127.0.0.1:{server.port}")
+        with pytest.raises(RuntimeError) as exc:
+            Observability(ctx).healthz()
+        msg = str(exc.value)
+        assert "disk_free_low" in msg
+        assert "disk" in msg and "alerts" in msg
+    finally:
+        server.stop()
+
+
+def test_debug_profile_gated_and_captures(served):
+    ctx, app = served
+    # Gated off by default → 403, never a capture.
+    resp = requests.post(ctx.url("/debug/profile"), json={"seconds": 0.1})
+    assert resp.status_code == 403
+    app.cfg.debug_profile = True
+    try:
+        bad = requests.post(ctx.url("/debug/profile"),
+                            json={"seconds": 10_000})
+        assert bad.status_code == 406
+        resp = requests.post(ctx.url("/debug/profile"),
+                             json={"seconds": 0.2})
+        assert resp.status_code == 201, resp.text
+        out = resp.json()
+        assert out["dir"].startswith(app.cfg.store_root)
+        app.jobs.wait_all(timeout=60)
+        files = [f for _, _, fs in os.walk(out["dir"]) for f in fs]
+        assert files, "profiler capture produced no trace files"
+        (job,) = [j for j in requests.get(ctx.url("/jobs")).json()
+                  if j["kind"] == "debug_profile"]
+        assert job["status"] == "done"
+    finally:
+        app.cfg.debug_profile = False
+
+
+def test_metrics_json_carries_resource_sections(served):
+    ctx, _app = served
+    doc = requests.get(ctx.url("/metrics")).json()
+    assert doc["resources"]["host"]["rss_bytes"] > 0
+    assert doc["compile"]["cache_misses"] == doc["compile"]["compiles"]
+    assert doc["pod"]["degraded"] is False
+    assert "firing" in doc["alerts"]
+    # The alert engine saw the SAME snapshot: its disk rule value equals
+    # the document's own free_bytes (no second, divergent sampling).
+    rule = doc["alerts"]["rules"].get("disk_free_low")
+    if rule is not None and rule["value"] is not None:
+        assert rule["value"] == pytest.approx(
+            doc["resources"]["disk"]["free_bytes"], rel=0.25)

@@ -418,6 +418,28 @@ def test_prometheus_exposition_live_scrape(served):
     assert doc["ops"]["tracing_test.prom_op"]["count"] == counts[-1]
     assert "tracing" in doc
 
+    # Resource & capacity plane series (ISSUE 10): the new lo_resource_*
+    # / lo_compile_* / lo_alert_* gauges render from the same snapshot
+    # and pass the same grammar sweep above.
+    for needle in ("lo_resource_host_rss_bytes",
+                   "lo_resource_host_open_fds",
+                   "lo_resource_disk_free_bytes",
+                   "lo_resource_device_total_bytes_in_use",
+                   "lo_compile_compiles", "lo_compile_compile_s",
+                   "lo_compile_cache_hits",
+                   "lo_alert_firing", "lo_alert_threshold",
+                   "lo_pod_degraded"):
+        assert re.search(rf"^{needle}(?:\{{| )", text, re.M), \
+            f"missing exposition series: {needle}"
+    # Every rule on /alerts has a firing gauge, and the JSON sections
+    # exist in the same document.
+    alert_names = set(doc["alerts"]["rules"])
+    exposed = set(re.findall(r'^lo_alert_firing\{alert="([^"]+)"\}',
+                             text, re.M))
+    assert exposed == alert_names
+    assert doc["resources"]["host"]["rss_bytes"] > 0
+    assert doc["compile"]["compiles"] >= 0
+
 
 # -- structured logs ----------------------------------------------------------
 
