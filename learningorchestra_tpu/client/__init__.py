@@ -35,6 +35,13 @@ class JobFailed(RuntimeError):
     pass
 
 
+class DeadlineExpired(RuntimeError):
+    """A per-call deadline budget ran out client-side: raised instead of
+    sending (or retrying) a request whose answer the caller no longer
+    wants. The server's 504 for the same condition also surfaces as
+    this, so callers handle one type either way."""
+
+
 class Context:
     """Connection context shared by the service clients.
 
@@ -99,13 +106,27 @@ class Context:
 
     def request(self, method: str, path: str,
                 timeout: Optional[float] = None,
-                retry_503: bool = True, **kwargs):
+                retry_503: bool = True,
+                deadline_ms: Optional[float] = None, **kwargs):
         """``retry_503=False`` returns a 503 response immediately instead
         of backing off: a health probe's 503 IS the answer (degraded),
         not backpressure to wait out. Connection-error retries keep
-        their normal budget either way."""
+        their normal budget either way.
+
+        ``deadline_ms`` is an END-TO-END budget for this logical call:
+        every attempt carries the REMAINING budget in ``X-Deadline-Ms``
+        (the server's admission control and in-queue expiry honor it),
+        retry sleeps and per-attempt socket timeouts are clamped so the
+        retry loop can never outlive the budget, and a spent budget
+        raises :class:`DeadlineExpired` client-side rather than sending
+        a request whose answer nobody will read. A 504 (the server's
+        terminal deadline answer) is NEVER retried — re-sending
+        already-abandoned work only deepens the overload that caused
+        the miss."""
         deadline = timeout if timeout is not None else self.request_timeout
         retries = self.retries
+        hard_deadline = (time.monotonic() + deadline_ms / 1e3
+                         if deadline_ms is not None else None)
         if method.upper() == "POST":
             # One key per LOGICAL create, shared by all its retries: the
             # server replays the first landed attempt's response.
@@ -115,10 +136,24 @@ class Context:
         attempt = 0
         slept = 0.0
 
+        def remaining_ms() -> Optional[float]:
+            if hard_deadline is None:
+                return None
+            return (hard_deadline - time.monotonic()) * 1e3
+
         def sleep(wait: float) -> bool:
-            """Sleep within the total-wait budget; False = budget spent."""
+            """Sleep within the total-wait budget; False = budget spent
+            (either the jitter budget or the caller's deadline)."""
             nonlocal slept
             wait = min(wait, max(0.0, self.max_retry_wait - slept))
+            rem = remaining_ms()
+            if rem is not None:
+                # A sleep that would consume the whole remaining budget
+                # guarantees the next attempt dies at admission: stop
+                # retrying instead.
+                if wait * 1e3 >= rem:
+                    return False
+                wait = min(wait, max(0.0, rem / 1e3))
             if wait <= 0 and slept >= self.max_retry_wait:
                 return False
             time.sleep(wait)
@@ -126,14 +161,53 @@ class Context:
             return True
 
         while True:
+            rem = remaining_ms()
+            attempt_timeout = deadline
+            if rem is not None:
+                if rem <= 0:
+                    raise DeadlineExpired(
+                        f"deadline budget ({deadline_ms:.0f}ms) spent "
+                        f"before {method} {path} could complete")
+                # Fresh copy per attempt: mutating a caller-supplied
+                # headers dict would leak this call's (stale, shrinking)
+                # budget into the caller's later requests.
+                headers = dict(kwargs.get("headers") or {})
+                headers["X-Deadline-Ms"] = str(int(max(1, rem)))
+                kwargs["headers"] = headers
+                # Small slack past the remaining budget: the server
+                # answers its terminal 504 AT the deadline, and cutting
+                # the socket exactly there loses the typed answer to a
+                # photo-finish race.
+                attempt_timeout = min(deadline, rem / 1e3 + 0.5)
             try:
                 resp = self._session().request(method, self.url(path),
-                                               timeout=deadline, **kwargs)
-            except requests.ConnectionError:
+                                               timeout=attempt_timeout,
+                                               **kwargs)
+            except requests.ConnectionError as e:
+                # ConnectTimeout is BOTH ConnectionError and Timeout: it
+                # is terminal-as-deadline only when the budget is
+                # actually gone; with budget left it keeps a connection
+                # error's normal retry behavior.
+                if hard_deadline is not None and isinstance(
+                        e, requests.Timeout) and (remaining_ms() or 0) <= 0:
+                    raise DeadlineExpired(
+                        f"deadline budget ({deadline_ms:.0f}ms) spent "
+                        f"connecting for {method} {path}") from None
                 if attempt >= retries or not sleep(self._backoff(attempt)):
                     raise
                 attempt += 1
                 continue
+            except requests.Timeout:
+                # Terminal DeadlineExpired ONLY when the budget really
+                # is gone (the attempt's socket timeout was the clamped
+                # remaining budget). A plain request_timeout firing with
+                # budget to spare stays a Timeout — misreporting it as
+                # a deadline miss would hide a retryable stall.
+                if hard_deadline is not None and (remaining_ms() or 0) <= 0:
+                    raise DeadlineExpired(
+                        f"deadline budget ({deadline_ms:.0f}ms) spent "
+                        f"waiting on {method} {path}") from None
+                raise
             if resp.status_code == 503 and retry_503 and attempt < retries:
                 # Pod mid-recovery (supervisor restart): honor the
                 # server's backoff hint, clamped.
@@ -192,9 +266,15 @@ class ResponseTreat:
             # call, resolvable via GET /trace/{id} and greppable in the
             # server's structured logs.
             rid = response.headers.get("X-Request-Id")
-            raise RuntimeError(
-                f"HTTP {response.status_code}: {payload.get('result')}"
-                + (f" [request-id {rid}]" if rid else ""))
+            msg = (f"HTTP {response.status_code}: {payload.get('result')}"
+                   + (f" [request-id {rid}]" if rid else ""))
+            if response.status_code == 504:
+                # The server's terminal deadline answer: typed so
+                # callers handle client-side and server-side budget
+                # expiry identically — and so nothing upstream is
+                # tempted to retry it.
+                raise DeadlineExpired(msg)
+            raise RuntimeError(msg)
         return json.dumps(payload, indent=2) if pretty else payload
 
 
@@ -487,7 +567,9 @@ class Model(_ServiceClient):
         return out
 
     def predict_online(self, model_name: str, rows: Sequence[Any],
-                       max_batch: int = 256) -> Dict[str, Any]:
+                       max_batch: int = 256,
+                       deadline_ms: Optional[float] = None
+                       ) -> Dict[str, Any]:
         """Request/response predictions from the online inference tier
         (``POST /trained-models/<name>/predict`` — no dataset, no job,
         no polling; inline feature rows in, predictions out).
@@ -506,8 +588,19 @@ class Model(_ServiceClient):
         its cap; the client reads it and re-splits once instead of
         failing — so the default call works against any server
         configuration. Results concatenate in row order.
+
+        ``deadline_ms`` is an end-to-end budget across the WHOLE call —
+        all micro-batches and any retries share it. Each POST carries
+        the remaining budget (``X-Deadline-Ms``; the server's admission
+        control and in-queue expiry honor it), retry backoff can never
+        outlive it, and expiry — client-side or the server's terminal
+        504 — raises :class:`DeadlineExpired` immediately, never
+        retrying (re-sending work the caller abandoned only deepens
+        the overload that caused the miss).
         """
         rows = list(rows)
+        hard_deadline = (time.monotonic() + deadline_ms / 1e3
+                         if deadline_ms is not None else None)
         if self._server_max_batch is not None:
             max_batch = min(max_batch, self._server_max_batch)
         for _ in range(2):                   # second pass: server's cap
@@ -520,9 +613,17 @@ class Model(_ServiceClient):
                 # a fabricated empty success would mask e.g. a typo'd
                 # model name.
                 for chunk in micro_batches(rows, max_batch) or [rows]:
+                    rem = None
+                    if hard_deadline is not None:
+                        rem = (hard_deadline - time.monotonic()) * 1e3
+                        if rem <= 0:
+                            raise DeadlineExpired(
+                                f"deadline budget ({deadline_ms:.0f}ms) "
+                                "spent mid-call; "
+                                f"{len(preds)}/{len(rows)} rows answered")
                     out = ResponseTreat.treatment(self.context.post(
                         f"/trained-models/{model_name}/predict",
-                        json={"rows": list(chunk)}))
+                        json={"rows": list(chunk)}, deadline_ms=rem))
                     preds.extend(out["predictions"])
                     probs.extend(out["probabilities"])
             except RuntimeError as e:

@@ -207,6 +207,46 @@ class Settings:
     serve_timeout_s: float = field(
         default_factory=lambda: _env("LO_TPU_SERVE_TIMEOUT_S", 30.0)
     )
+    #: Default end-to-end deadline budget (milliseconds) applied to a
+    #: predict request that carries no ``X-Deadline-Ms`` header. 0 = no
+    #: implicit deadline (requests wait out ``serve_timeout_s``). A
+    #: request whose budget expires — at admission (predicted queue wait
+    #: exceeds the remaining budget) or in queue — answers a terminal
+    #: 504, and its rows are never dispatched to the device.
+    serve_deadline_default_ms: float = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_DEADLINE_DEFAULT_MS", 0.0)
+    )
+    #: Upper clamp (milliseconds) on client-supplied deadline budgets —
+    #: a confused client must not park a handler thread for an hour.
+    #: 0 disables deadline handling entirely (headers are ignored).
+    serve_deadline_cap_ms: float = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_DEADLINE_CAP_MS",
+                                     600000.0)
+    )
+    #: Consecutive dispatcher-thread crashes (exceptions escaping the
+    #: dispatch loop, not per-request model errors) before a model is
+    #: QUARANTINED: its predicts answer a terminal 503 naming the
+    #: quarantine instead of endlessly crash-looping, and the
+    #: ``serving_quarantined`` alert fires. A successful dispatch resets
+    #: the streak; DELETE or re-save (invalidate) lifts the quarantine.
+    serve_quarantine_crashes: int = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_QUARANTINE_CRASHES", 3)
+    )
+    #: First supervised-restart backoff (seconds) after a dispatcher
+    #: crash; doubles per consecutive crash, capped at 5 s so teardown
+    #: joins stay bounded.
+    serve_restart_backoff_s: float = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_RESTART_BACKOFF_S", 0.2)
+    )
+    #: Graceful-drain window (seconds): on SIGTERM (or a programmatic
+    #: ``App.drain``) the server stops admitting new work (503 +
+    #: Retry-After + ``Connection: close``), lets in-flight predicts and
+    #: queued jobs finish for up to this long, then stops. The
+    #: supervisor's planned-restart path (SIGHUP) grants children this
+    #: window before escalating to SIGKILL.
+    drain_timeout_s: float = field(
+        default_factory=lambda: _env("LO_TPU_DRAIN_TIMEOUT_S", 30.0)
+    )
 
     # --- training ----------------------------------------------------------
     #: Max concurrently running model fits (reference: 5 classifiers through
@@ -333,6 +373,14 @@ class Settings:
     #: backpressure — capacity, not a blip). 0 disables the rule.
     slo_reject_rate: float = field(
         default_factory=lambda: _env("LO_TPU_SLO_REJECT_RATE", 0.05)
+    )
+    #: Deadline-miss-rate SLO: deadline-expired / offered predict
+    #: requests per window above this ratio fires
+    #: ``serving_deadline_exceeded_rate`` — callers are giving up on a
+    #: sustained fraction of answers, so the device is burning time the
+    #: clients no longer want. 0 disables the rule.
+    slo_deadline_rate: float = field(
+        default_factory=lambda: _env("LO_TPU_SLO_DEADLINE_RATE", 0.05)
     )
     #: Disk-headroom watermark (MiB) for the chunk store's filesystem:
     #: free bytes under it fires ``disk_free_low`` and degrades

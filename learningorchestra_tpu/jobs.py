@@ -309,3 +309,11 @@ class JobManager:
     def records(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [r.to_doc() for r in self._jobs.values()]
+
+    def running_count(self) -> int:
+        """Jobs not yet terminal (includes pool-queued ones — their
+        record is minted "running" at submit): the drain loop's quiesce
+        probe for the job plane."""
+        with self._lock:
+            return sum(1 for r in self._jobs.values()
+                       if r.status == "running")

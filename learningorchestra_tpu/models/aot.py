@@ -47,7 +47,13 @@ import numpy as np
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.models.persistence import ModelRegistry
 from learningorchestra_tpu.models.registry import ONLINE_KINDS
-from learningorchestra_tpu.utils import resources
+from learningorchestra_tpu.utils import failpoints, resources
+
+#: Chaos seam before a model's bucket-ladder load+compile — raise-mode
+#: proves a failed cold load surfaces as the request's error (never a
+#: half-cached entry), slow/hang-mode that compile stalls block only the
+#: loading model's requests (per-name lock, docs/fault_tolerance.md §7).
+FP_PRE_COMPILE = failpoints.declare("serving.aot.pre_compile")
 
 
 def predict_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -376,6 +382,7 @@ class AotCache:
             # ABA), so token-before == token-after proves the loaded
             # snapshot corresponds to that token; a retry costs one
             # checkpoint restore, never a compile.
+            failpoints.fire(FP_PRE_COMPILE)
             while True:
                 manifest, model = self.registry.load(name)
                 after = self.registry.version(name)

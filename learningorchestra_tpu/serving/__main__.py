@@ -7,6 +7,9 @@ them into one mesh (env: LO_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID).
 """
 
 import argparse
+import os
+import signal
+import threading
 
 from learningorchestra_tpu.config import settings
 from learningorchestra_tpu.parallel import distributed
@@ -14,6 +17,55 @@ from learningorchestra_tpu.serving.app import App
 from learningorchestra_tpu.utils import structlog
 
 log = structlog.get_logger("serving.main")
+
+
+def install_graceful_shutdown(app: App, server) -> threading.Event:
+    """Wire SIGTERM/SIGINT to a graceful drain of ``app`` + ``server``:
+    the signal gates off new work (503 + Retry-After + Connection:
+    close), in-flight predicts and queued jobs finish within
+    ``LO_TPU_DRAIN_TIMEOUT_S``, then the server stops and the returned
+    event is set — a planned restart loses zero accepted requests.
+    Exposed so the chaos drain test drives the EXACT production signal
+    path through a child process (tests/drain_child.py)."""
+    stopped = threading.Event()
+    drain_started = threading.Event()
+
+    def _graceful(signum, _frame):
+        # Signal frame: do nothing blocking here. The drain itself —
+        # waiting out in-flight predicts and queued jobs, then stopping
+        # the server — runs on its own thread; SIGTERM/SIGINT land in
+        # the main thread, which is parked on `stopped` by the caller.
+        if drain_started.is_set():
+            # Second signal while draining = the operator insists. The
+            # drain is timeout-bounded but server.stop() is not — if it
+            # wedged, nothing else would ever release the main thread,
+            # leaving the process killable only by SIGKILL. Exit with
+            # the conventional fatal-signal code so a supervisor reads
+            # it as a kill, not a clean stop.
+            log.error("second signal %d during drain: forcing exit",
+                      signum)
+            os._exit(128 + signum)
+        drain_started.set()
+        log.warning("signal %d received: graceful drain (up to %.0fs)",
+                    signum, app.cfg.drain_timeout_s)
+
+        def _drain():
+            try:
+                app.drain()
+            finally:
+                server.stop()
+                stopped.set()
+
+        # thread-lifecycle: owner=serving.__main__; exits after
+        # drain+server.stop complete and sets `stopped`, which releases
+        # the main thread to exit the process (daemon: a wedged stop
+        # cannot outlive the interpreter).
+        threading.Thread(target=_drain, name="lo-drain",
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    return stopped
 
 
 def main() -> None:
@@ -67,8 +119,10 @@ def main() -> None:
     app = App(settings, recover=not args.no_recover)
     log.info("learningorchestra_tpu serving on %s:%d (devices: %s)",
              args.host, args.port, distributed.process_info()["devices"])
+    server = app.serve(background=True)
+    stopped = install_graceful_shutdown(app, server)
     try:
-        app.serve()
+        stopped.wait()
     finally:
         spmd.shutdown_workers()
 

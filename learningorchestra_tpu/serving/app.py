@@ -18,7 +18,9 @@ the upgrade that failed jobs set ``error`` and still flip ``finished``.
 from __future__ import annotations
 
 import json
+import math
 import os
+import threading
 import time
 from typing import Optional
 
@@ -36,7 +38,8 @@ from learningorchestra_tpu.ops.projection import create_projection
 from learningorchestra_tpu.parallel import distributed, spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
 from learningorchestra_tpu.serving.batcher import (
-    BatcherStopped, PredictBatcher, PredictTimeout, QueueFull)
+    BatcherStopped, DeadlineExceeded, DispatcherCrashed, ModelQuarantined,
+    PredictBatcher, PredictTimeout, QueueFull)
 from learningorchestra_tpu.serving.http import (
     FileResponse, HtmlResponse, HttpError, IdempotencyCache, Router,
     Server, TextResponse)
@@ -83,6 +86,11 @@ class App:
         #: of /metrics, /alerts, /healthz and the status page drive its
         #: evaluation windows (the Prometheus scrape-window model).
         self.alerts = alerts.default_engine(self.cfg)
+        #: Graceful-drain latch (SIGTERM / App.drain): once set, new
+        #: work answers 503 + Retry-After + Connection: close while
+        #: in-flight predicts and queued jobs run to completion —
+        #: a planned restart loses zero accepted requests.
+        self._draining = threading.Event()
         self.router = Router()
         self._register()
         if recover and self.cfg.persist:
@@ -108,16 +116,55 @@ class App:
         """
 
         def convert(req):
+            if req.method in ("POST", "PATCH", "DELETE") and \
+                    self._draining.is_set():
+                # Draining: no NEW work — in-flight requests finish,
+                # reads keep serving (operators watch the drain through
+                # them). Connection: close sheds the keep-alive socket
+                # so the client's retry lands on a healthy peer instead
+                # of this exiting process.
+                raise HttpError(
+                    503, "server draining for shutdown; retry elsewhere",
+                    headers={"Retry-After": str(max(
+                        1, math.ceil(self.cfg.drain_timeout_s))),
+                        "Connection": "close"})
             try:
                 return fn(req)
             except QueueFull as e:
                 # Predict queue at capacity: backpressure, not failure.
                 # Retry-After + 503 is the contract the client's
-                # jittered backoff already honors (PR 2/PR 4).
+                # jittered backoff already honors (PR 2/PR 4); the hint
+                # is COMPUTED from predicted queue wait (depth × recent
+                # per-row service rate, serving/batcher.py) — when to
+                # come back, not a constant.
                 raise HttpError(
                     503, str(e),
                     headers={"Retry-After":
-                             str(max(1, int(e.retry_after_s)))})
+                             str(max(1, math.ceil(e.retry_after_s)))})
+            except DeadlineExceeded as e:
+                # The caller's end-to-end budget is unmeetable or
+                # already spent: a TERMINAL 504 — distinct from the
+                # retryable 503 family on purpose (the client never
+                # retries it; re-sending abandoned work only deepens
+                # overload). No Retry-After: there is nothing to wait
+                # for, the budget belonged to the caller.
+                raise HttpError(504, str(e))
+            except ModelQuarantined as e:
+                # Terminal until an operator (or a re-save) lifts it —
+                # a long Retry-After so stock clients' bounded backoff
+                # gives up fast instead of hammering a dead model.
+                raise HttpError(
+                    503, str(e),
+                    headers={"Retry-After": str(max(
+                        1, math.ceil(self.cfg.restart_backoff_max_s)))})
+            except DispatcherCrashed as e:
+                # The dispatcher crashed after this request's batch hit
+                # the device; the supervised restart is already under
+                # way — hint its first backoff step.
+                raise HttpError(
+                    503, str(e),
+                    headers={"Retry-After": str(max(
+                        1, math.ceil(self.cfg.serve_restart_backoff_s)))})
             except PredictTimeout as e:
                 raise HttpError(503, str(e), headers={"Retry-After": "5"})
             except BatcherStopped as e:
@@ -131,10 +178,16 @@ class App:
                 raise HttpError(500, str(e))
             except spmd.PodDegraded as e:
                 # A degraded pod is mid-recovery (its supervisor restarts
-                # it under a new mesh epoch): answer 503 + Retry-After so
-                # clients back off and retry, instead of a 500 that reads
-                # as a server bug.
-                raise HttpError(503, str(e), headers={"Retry-After": "5"})
+                # it under a new mesh epoch): answer 503 + Retry-After
+                # COMPUTED from the recovery machinery's own knobs — the
+                # supervisor needs a health-poll interval to notice plus
+                # its first restart backoff — instead of a hard-coded
+                # constant.
+                raise HttpError(
+                    503, str(e),
+                    headers={"Retry-After": str(max(1, math.ceil(
+                        self.cfg.health_interval_s
+                        + self.cfg.restart_backoff_s)))})
             except DatasetNotFound as e:
                 raise HttpError(404, f"dataset not found: {e}")
             except ImageNotFound as e:
@@ -166,6 +219,31 @@ class App:
                 self._wrap(fn, replay_posts=replay_posts))
 
         return deco
+
+    def _deadline_ms(self, header: Optional[str]) -> Optional[float]:
+        """The effective deadline budget for one predict request:
+        client header clamped to ``serve_deadline_cap_ms``, falling back
+        to ``serve_deadline_default_ms`` (0 = none). A malformed header
+        is a client error worth naming, not silently ignoring."""
+        cap = float(self.cfg.serve_deadline_cap_ms)
+        if cap <= 0:
+            return None                    # deadline handling disabled
+        if header is None or not str(header).strip():
+            default = float(self.cfg.serve_deadline_default_ms)
+            return min(default, cap) if default > 0 else None
+        try:
+            budget = float(header)
+        except ValueError:
+            raise ValueError(
+                f"X-Deadline-Ms must be a number of milliseconds, got "
+                f"{header!r}") from None
+        if budget <= 0:
+            # The caller's budget is already spent: pass it through —
+            # the predict tier answers the terminal 504 WITH per-model
+            # accounting (deadline_exceeded counter + trace record),
+            # which raising here would silently skip.
+            return budget
+        return min(budget, cap)
 
     # -- routes --------------------------------------------------------------
 
@@ -325,11 +403,17 @@ class App:
         def model_predict_online(req):
             spmd.require_pod_health()
             (rows,) = req.require("rows")
+            # End-to-end deadline: the client's remaining budget rides
+            # the X-Deadline-Ms header (clamped; absent → the server
+            # default, 0 = none). Admission, queueing and dispatch all
+            # honor it (serving/batcher.py) — expiry is a terminal 504.
+            deadline_ms = app._deadline_ms(req.header("X-Deadline-Ms"))
             # Thin enqueue/await shim: feature prep runs here on the
             # handler thread; the per-model dispatcher thread coalesces
             # concurrent requests into one padded AOT device dispatch
             # and scatters the rows back (serving/batcher.py).
-            return 200, app.predictor.predict(req.params["name"], rows)
+            return 200, app.predictor.predict(req.params["name"], rows,
+                                              deadline_ms=deadline_ms)
 
         @self._route("POST", "/trained-models/{name}/predictions")
         def model_predict(req):
@@ -421,6 +505,7 @@ class App:
             info["mesh"] = dict(app.runtime.mesh.shape)
             info["mesh_epoch"] = spmd.mesh_epoch()
             info["pod_error"] = spmd.pod_error()
+            info["state"] = "draining" if app.draining else "serving"
             # The page's 5 s auto-refresh doubles as the alert engine's
             # heartbeat on watched deployments (_metrics_doc evaluates).
             mdoc = app._metrics_doc()
@@ -527,7 +612,8 @@ class App:
         for r in self.jobs.records():
             by_status[r["status"]] = by_status.get(r["status"], 0) + 1
         pod_error = spmd.pod_error()
-        doc = {"ops": op_timer.snapshot(),
+        doc = {"state": "draining" if self.draining else "serving",
+               "ops": op_timer.snapshot(),
                "jobs": by_status,
                "integrity": self.store.integrity_snapshot(),
                "read_pipeline": readpipe.snapshot(),
@@ -544,9 +630,13 @@ class App:
 
     def _health_doc(self) -> dict:
         """The deep ``GET /healthz`` rollup: pod health, disk headroom,
-        predict-dispatcher liveness, and the alert summary — 200 when
-        every check passes and no critical alert fires, 503 (with this
-        same JSON detail) otherwise."""
+        predict-dispatcher liveness, lifecycle state, and the alert
+        summary — 200 when every check passes and no critical alert
+        fires, 503 (with this same JSON detail) otherwise. A DRAINING
+        server reports ``state: draining`` and is unhealthy by design:
+        load balancers must stop routing to a process about to exit,
+        while the in-flight work it still owes completes behind the
+        gate."""
         mdoc = self._metrics_doc()
         disk = (mdoc.get("resources") or {}).get("disk") or {}
         watermark = int(self.cfg.disk_free_watermark_mb) * (1 << 20)
@@ -556,15 +646,19 @@ class App:
         pod_error = (mdoc.get("pod") or {}).get("error")
         firing = self.alerts.firing()
         critical = self.alerts.firing(severity="critical")
+        draining = self._draining.is_set()
         checks = {
             "pod": {"ok": pod_error is None, "error": pod_error},
             "disk": {"ok": disk_ok, "free_bytes": free,
                      "watermark_bytes": watermark},
             "dispatchers": dispatchers,
+            "lifecycle": {"ok": not draining,
+                          "state": "draining" if draining else "serving"},
             "alerts": {"ok": not critical, "firing": firing,
                        "critical": critical},
         }
         return {"healthy": all(c["ok"] for c in checks.values()),
+                "state": "draining" if draining else "serving",
                 "checks": checks,
                 "mesh_epoch": spmd.mesh_epoch()}
 
@@ -692,6 +786,49 @@ class App:
             self.jobs.submit(f"retry_{spec['kind']}", names, runner)
 
     # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        """Flip the app into the draining state: new work (POST/PATCH/
+        DELETE) answers 503 + Retry-After + ``Connection: close``,
+        reads and already-accepted work continue, ``/healthz`` reports
+        ``draining`` (→ 503, so load balancers depool this process).
+        Idempotent."""
+        if not self._draining.is_set():
+            self._draining.set()
+            log.warning("draining: new work rejected 503; waiting for "
+                        "in-flight predicts and queued jobs")
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Gate off new work, then wait (up to ``timeout_s``, default
+        ``LO_TPU_DRAIN_TIMEOUT_S``) for every accepted predict to
+        scatter back and every queued job to reach a terminal state —
+        job completion implies its journal fsyncs committed, so nothing
+        durable is in flight when this returns. Then stop the predict
+        dispatchers. Returns True when fully quiesced within the
+        window, False when the timeout expired with work still running
+        (the caller exits anyway — bounded beats perfect on the way
+        down)."""
+        self.begin_drain()
+        deadline = time.monotonic() + float(
+            self.cfg.drain_timeout_s if timeout_s is None else timeout_s)
+        quiesced = False
+        while time.monotonic() < deadline:
+            if self.predictor.quiesced() and self.jobs.running_count() == 0:
+                quiesced = True
+                break
+            time.sleep(0.05)
+        if quiesced:
+            log.info("drain complete: all accepted work finished")
+        else:
+            log.error("drain timeout: exiting with work still in flight "
+                      "(predict queues quiesced=%s, running jobs=%d)",
+                      self.predictor.quiesced(), self.jobs.running_count())
+        self.predictor.stop()
+        return quiesced
 
     def serve(self, background: bool = False) -> Server:
         server = Server(self.router, self.cfg.host, self.cfg.port,

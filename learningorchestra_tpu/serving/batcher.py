@@ -33,6 +33,33 @@ taken), and link one ``batch.coalesce`` span per coalesced dispatch as
 the parent of every co-batched request's ``dispatch.device`` span:
 queue wait, device time, and scatter tail finally separate per request
 instead of blurring into one p99.
+
+Fault domain (PR 11):
+
+- **End-to-end deadlines** — a request may carry a deadline budget
+  (``X-Deadline-Ms`` → :meth:`PredictBatcher.predict`). Admission
+  rejects up front when the predicted queue wait (queue depth × the
+  recent per-row service rate, an EWMA the dispatcher maintains)
+  already exceeds the remaining budget; the dispatcher discards
+  requests that expired while queued BEFORE padding them into a batch
+  (device time is never spent answering a caller that gave up); both
+  map to a terminal 504 (:class:`DeadlineExceeded`), never a retryable
+  503, and the expiry is recorded on the request's trace.
+- **Dispatcher self-healing** — the per-model dispatcher thread runs
+  under in-process supervision: an exception escaping the dispatch loop
+  (the PR 6 silent-death class) restarts the loop under exponential
+  backoff, re-queuing in-flight requests the device never saw and
+  failing already-dispatched ones 503 (:class:`DispatcherCrashed` — the
+  client retries). ``serve_quarantine_crashes`` consecutive crashes
+  quarantine the model (:class:`ModelQuarantined`, terminal 503 naming
+  the quarantine + the ``serving_quarantined`` alert) instead of
+  crash-looping; DELETE or re-save lifts it.
+- **Chaos seams** — ``serving.batcher.pre_dispatch`` fires after a
+  batch is taken but before any device work (raise-mode = a dispatcher
+  crash whose batch is safely re-queued), ``serving.batcher.
+  mid_dispatch`` after the device computed but before scatter
+  (raise-mode = a crash whose batch must fail 503: re-dispatching would
+  double-spend device time).
 """
 
 from __future__ import annotations
@@ -40,14 +67,34 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.models.aot import AotCache, design_from_rows
 from learningorchestra_tpu.models.persistence import ModelRegistry
-from learningorchestra_tpu.utils import profiling, tracing
+from learningorchestra_tpu.utils import failpoints, profiling, tracing
+from learningorchestra_tpu.utils.structlog import get_logger
+
+log = get_logger("serving.batcher")
+
+#: Chaos seams for the predict dispatch path (docs/fault_tolerance.md §7).
+FP_PRE_DISPATCH = failpoints.declare("serving.batcher.pre_dispatch")
+FP_MID_DISPATCH = failpoints.declare("serving.batcher.mid_dispatch")
+
+#: EWMA weight of the newest per-row service-rate sample — a few batches
+#: of history, so the queue-wait prediction tracks load shifts within
+#: seconds without one outlier dispatch whipsawing admission.
+_RATE_ALPHA = 0.3
+#: Supervised dispatcher restarts back off exponentially up to this cap
+#: (seconds) — also bounds how long stop() can wait behind a backoff.
+_RESTART_BACKOFF_CAP_S = 5.0
+#: Retry-After hints computed from predicted queue wait clamp into this
+#: range (seconds): at least 1 (the header is integral and 0 means
+#: hammer-now), at most 60 (a confused rate estimate must not park
+#: clients for an hour).
+_RETRY_AFTER_MIN_S, _RETRY_AFTER_MAX_S = 1.0, 60.0
 
 #: Completion timestamps kept per model for the QPS window.
 _QPS_SAMPLES = 2048
@@ -78,16 +125,58 @@ class BatcherStopped(Exception):
     was re-saved."""
 
 
+class DeadlineExceeded(Exception):
+    """The request's end-to-end deadline budget cannot be (or was not)
+    met — terminal: mapped to **504**, which the client never retries
+    (re-sending work whose caller already gave up only deepens the
+    overload). ``phase`` says where the budget died: ``admission``
+    (predicted queue wait exceeded the remaining budget up front — the
+    rows never even queued) or ``queue`` (it expired waiting — the rows
+    were discarded before any device dispatch)."""
+
+    def __init__(self, model: str, budget_ms: float, waited_ms: float,
+                 phase: str, predicted_wait_ms: Optional[float] = None):
+        detail = (f"; predicted queue wait {predicted_wait_ms:.0f}ms"
+                  if predicted_wait_ms is not None else "")
+        super().__init__(
+            f"deadline exceeded for model {model}: budget {budget_ms:.0f}ms"
+            f", waited {waited_ms:.0f}ms at {phase}{detail}")
+        self.model = model
+        self.budget_ms = budget_ms
+        self.waited_ms = waited_ms
+        self.phase = phase
+
+
+class DispatcherCrashed(Exception):
+    """This request's batch was in flight when the dispatcher thread
+    crashed AFTER device dispatch — its results are lost and re-running
+    them would double-spend device time, so it fails here. Transient:
+    mapped to 503 + Retry-After; the supervised restart is already
+    bringing the dispatcher back for the retry."""
+
+
+class ModelQuarantined(Exception):
+    """The model's dispatcher crashed ``serve_quarantine_crashes``
+    consecutive times and the model is quarantined: predicts answer this
+    terminal 503 naming the quarantine instead of feeding a crash loop.
+    DELETE or re-save (anything that invalidates the batcher) lifts it."""
+
+
 class _Pending:
     """One enqueued request: its design rows, the AOT entry its design
     was built against, the submitting request's trace context (so the
-    dispatcher thread can record spans INTO that request's trace), and
-    the slot the dispatcher scatters the result (or error) into."""
+    dispatcher thread can record spans INTO that request's trace), its
+    optional deadline, and the slot the dispatcher scatters the result
+    (or error) into. ``dispatched`` flips just before the device runs
+    its batch — the supervision's re-queue-or-fail decision on a crash."""
 
     __slots__ = ("X", "entry", "ctx", "done", "probs", "error",
-                 "t_enqueue", "t_taken")
+                 "t_enqueue", "t_taken", "deadline", "budget_ms",
+                 "dispatched")
 
-    def __init__(self, X: np.ndarray, entry: Any):
+    def __init__(self, X: np.ndarray, entry: Any,
+                 deadline: Optional[float] = None,
+                 budget_ms: Optional[float] = None):
         self.X = X
         self.entry = entry
         self.ctx = tracing.current()
@@ -96,6 +185,11 @@ class _Pending:
         self.error: Optional[Exception] = None
         self.t_enqueue = time.monotonic()
         self.t_taken: Optional[float] = None
+        #: Absolute monotonic instant the caller's budget runs out, or
+        #: None for no deadline.
+        self.deadline = deadline
+        self.budget_ms = budget_ms
+        self.dispatched = False
 
 
 class _Stats:
@@ -119,6 +213,14 @@ class _Stats:
         self.rejected = 0
         self.timeouts = 0
         self.errors = 0
+        self.deadline_exceeded = 0
+        self.dispatcher_restarts = 0
+        self.quarantined = 0
+        #: EWMA of device seconds per row over recent dispatches — the
+        #: service rate behind predicted queue wait (deadline admission
+        #: and computed Retry-After hints). 0.0 until the first dispatch
+        #: (a cold model admits everything: no evidence, no rejection).
+        self.service_s_per_row = 0.0
         self.lat_buckets = profiling.new_histogram()
         self.lat_sum_s = 0.0
         #: Two-epoch rotating window for recency-sensitive percentiles:
@@ -155,6 +257,23 @@ class _Stats:
         self.lat_sum_s += latency_s
         self.completions.append(now)
 
+    def observe_dispatch(self, rows: int, device_s: float) -> None:
+        """Fold one dispatch's per-row device time into the service-rate
+        EWMA (caller holds the stats lock)."""
+        if rows <= 0:
+            return
+        sample = max(0.0, device_s) / rows
+        self.service_s_per_row = (
+            sample if self.service_s_per_row <= 0.0
+            else (1 - _RATE_ALPHA) * self.service_s_per_row
+            + _RATE_ALPHA * sample)
+
+    def predicted_wait_s(self, queue_rows: int) -> float:
+        """Expected seconds until ``queue_rows`` currently-queued rows
+        have been served — depth × the recent per-row service rate. 0.0
+        before any dispatch established a rate."""
+        return max(0, queue_rows) * self.service_s_per_row
+
     def snapshot(self, queue_rows: int) -> Dict[str, Any]:
         now = time.monotonic()
         self._maybe_rotate(now)
@@ -179,11 +298,18 @@ class _Stats:
             "requests": self.requests,
             "rows": self.rows,
             "batches": self.batches,
+            # Rows the DEVICE actually saw — the deadline tests pin that
+            # expired rows never count here.
+            "batched_rows": self.batched_rows,
             "mean_batch_rows": (round(self.batched_rows / self.batches, 3)
                                 if self.batches else 0.0),
             "rejected": self.rejected,
             "timeouts": self.timeouts,
             "errors": self.errors,
+            "deadline_exceeded": self.deadline_exceeded,
+            "dispatcher_restarts": self.dispatcher_restarts,
+            "quarantined": self.quarantined,
+            "service_us_per_row": round(self.service_s_per_row * 1e6, 3),
             "queue_rows": queue_rows,
             "qps": round(qps, 3),
             "p50_ms": pct(0.50),
@@ -204,50 +330,129 @@ class ModelBatcher:
         self._queue: collections.deque = collections.deque()
         self._queue_rows = 0
         self._stopped = False
+        #: Set by stop(): interrupts a supervised-restart backoff sleep.
+        self._stopping = threading.Event()
+        #: Consecutive dispatcher crashes (reset by a clean batch);
+        #: reaching serve_quarantine_crashes quarantines the model.
+        self._crashes = 0
+        #: Quarantine reason once terminal, else None.
+        self._quarantined: Optional[str] = None
+        #: The batch the dispatcher currently holds outside the queue —
+        #: what supervision re-queues or fails after a crash. Touched
+        #: only by the dispatcher thread (and by supervision after that
+        #: same thread's loop died), so it needs no lock.
+        self._inflight: List[_Pending] = []
         # thread-lifecycle: owner=ModelBatcher; exits when stop() sets
-        # _stopped under the cond (joined there, 5s timeout); _loop's
-        # per-group try/except scatters dispatch errors to requests, and
-        # an escape above it is caught by the test harness's
-        # threading.excepthook sanitizer (the PR 6 silent-death class).
+        # _stopped under the cond (joined there, bounded timeout) or on
+        # quarantine. _run supervises _loop: an exception escaping the
+        # dispatch loop (the PR 6 silent-death class) restarts it under
+        # exponential backoff instead of dying silently; per-request
+        # model errors are scattered by _loop's per-group try/except and
+        # never reach supervision.
         self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=f"lo-predict-{name}")
+            target=self._run, daemon=True, name=f"lo-predict-{name}")
         self._thread.start()
 
     # -- handler side --------------------------------------------------------
 
-    def submit(self, X: np.ndarray, entry: Any) -> np.ndarray:
+    def quarantined(self) -> Optional[str]:
+        with self._cond:
+            return self._quarantined
+
+    def submit(self, X: np.ndarray, entry: Any,
+               deadline: Optional[float] = None,
+               budget_ms: Optional[float] = None) -> np.ndarray:
         """Enqueue one request's rows and block until its batch lands.
         ``entry`` is the AOT entry ``X`` was designed against — the
         dispatcher evaluates through it, never through a fresher one
         (a hot-swap between preprocessing and dispatch must not run
-        old-state rows through new params). Raises QueueFull at
-        capacity (→ 503 upstream) and re-raises any dispatch-side error
-        on the submitting thread."""
+        old-state rows through new params). ``deadline`` is the absolute
+        monotonic instant the caller's budget expires (None = none).
+        Raises QueueFull at capacity (→ 503 upstream), DeadlineExceeded
+        (→ terminal 504) when the budget is already unmeetable or runs
+        out in queue, and re-raises any dispatch-side error on the
+        submitting thread."""
         n = len(X)
         with self._cond:
+            if self._quarantined:
+                raise ModelQuarantined(self._quarantined)
             if self._stopped:
                 raise BatcherStopped(
                     f"predict dispatcher for model {self.name} stopped")
+            queue_rows = self._queue_rows
+            if deadline is not None:
+                # Admission control: if the rows already waiting are
+                # predicted to outlast the remaining budget, spending a
+                # queue slot (and later device time) on this request
+                # only manufactures a guaranteed-dead answer.
+                with _stats_lock:
+                    wait_s = self.stats.predicted_wait_s(queue_rows)
+                remaining = deadline - time.monotonic()
+                if wait_s > remaining:
+                    with _stats_lock:
+                        self.stats.deadline_exceeded += 1
+                    exc = DeadlineExceeded(
+                        self.name, budget_ms or 0.0,
+                        max(0.0, (budget_ms or 0.0) - remaining * 1e3),
+                        "admission", predicted_wait_ms=wait_s * 1e3)
+                    tracing.record_span(
+                        "deadline.rejected", 0.0,
+                        attrs={"model": self.name, "rows": n,
+                               "budget_ms": budget_ms,
+                               "predicted_wait_ms": round(wait_s * 1e3, 3)},
+                        status="error", error=str(exc))
+                    raise exc
             depth = int(self.cfg.serve_queue_depth)
-            if self._queue_rows + n > depth:
+            if queue_rows + n > depth:
                 with _stats_lock:
                     self.stats.rejected += 1
-                raise QueueFull(self.name, self._queue_rows)
-            pending = _Pending(X, entry)
+                    # Computed backpressure hint: how long the queue is
+                    # predicted to take to drain, clamped — not the old
+                    # hard-coded constant.
+                    retry_after = min(
+                        _RETRY_AFTER_MAX_S,
+                        max(_RETRY_AFTER_MIN_S,
+                            self.stats.predicted_wait_s(queue_rows)))
+                raise QueueFull(self.name, queue_rows,
+                                retry_after_s=retry_after)
+            pending = _Pending(X, entry, deadline=deadline,
+                               budget_ms=budget_ms)
             self._queue.append(pending)
             self._queue_rows += n
             self._cond.notify_all()
-        if not pending.done.wait(float(self.cfg.serve_timeout_s)):
+        wait_s = float(self.cfg.serve_timeout_s)
+        if deadline is not None:
+            wait_s = min(wait_s, max(0.0, deadline - time.monotonic()))
+        if not pending.done.wait(wait_s):
             # Withdraw the dead request: if it is still queued, the
             # device must not burn a dispatch computing rows nobody
             # will read (the 503'd client is already re-sending them).
             # Already-taken requests compute wastefully once — bounded.
+            withdrew = True
             with self._cond:
                 try:
                     self._queue.remove(pending)
                     self._queue_rows -= n
                 except ValueError:
-                    pass                    # dispatcher already took it
+                    withdrew = False        # dispatcher already took it
+            waited_ms = (time.monotonic() - pending.t_enqueue) * 1e3
+            if deadline is not None and time.monotonic() >= deadline:
+                # Count only when WE removed it: a pending the
+                # dispatcher already took is either discarded by
+                # _discard_expired (which counts it there) or computed
+                # as bounded waste — counting here too would double the
+                # rate alert's numerator for one expiry.
+                exc = DeadlineExceeded(self.name, budget_ms or 0.0,
+                                       waited_ms, "queue")
+                if withdrew:
+                    with _stats_lock:
+                        self.stats.deadline_exceeded += 1
+                    tracing.record_span(
+                        "deadline.expired", waited_ms / 1e3,
+                        attrs={"model": self.name, "rows": n,
+                               "budget_ms": budget_ms},
+                        status="error", error=str(exc))
+                raise exc
             with _stats_lock:
                 self.stats.timeouts += 1
             raise PredictTimeout(
@@ -268,22 +473,36 @@ class ModelBatcher:
 
     def thread_alive(self) -> bool:
         """Liveness probe for the health rollup: True while the
-        dispatcher thread runs OR it was stopped deliberately — only a
+        dispatcher thread runs OR it exited deliberately (stop or
+        quarantine — both answer requests with a mapped status) — only a
         dead-but-not-stopped thread (the PR 6 silent-death class the
         thread sanitizer hunts) reads as unhealthy."""
         with self._cond:
-            if self._stopped:
+            if self._stopped or self._quarantined:
                 return True
         return self._thread.is_alive()
 
+    def outstanding(self) -> int:
+        """Requests this batcher still owes an answer: queued plus taken
+        but not yet scattered — the drain loop's quiesce probe."""
+        with self._cond:
+            queued = len(self._queue)
+        return queued + sum(1 for p in self._inflight
+                            if not p.done.is_set())
+
     # -- worker side ---------------------------------------------------------
 
-    def _take_batch(self) -> List[_Pending]:
+    def _take_batch(self) -> Tuple[List[_Pending], List[_Pending]]:
         """Pop up to ``serve_max_batch`` rows' worth of waiting requests,
         lingering up to ``serve_max_wait_ms`` for a fuller batch. Whole
         requests only — a single request never splits across dispatches,
-        so scatter-back is a simple offset walk."""
+        so scatter-back is a simple offset walk. Requests whose deadline
+        already passed are DISCARDED here instead of batched — padding a
+        dead caller's rows into a dispatch spends device time answering
+        nobody — and returned separately for 504 scatter + accounting
+        (outside the cond)."""
         max_rows = max(1, int(self.cfg.serve_max_batch))
+        expired: List[_Pending] = []
         with self._cond:
             # Plain wait: submit() and stop() both notify under the
             # cond, so an idle dispatcher sleeps silently instead of
@@ -291,7 +510,7 @@ class ModelBatcher:
             while not self._queue and not self._stopped:
                 self._cond.wait()
             if self._stopped and not self._queue:
-                return []
+                return [], []
             deadline = (time.monotonic()
                         + float(self.cfg.serve_max_wait_ms) / 1e3)
             # _queue_rows is maintained by submit/_take_batch/timeout
@@ -304,25 +523,60 @@ class ModelBatcher:
                 self._cond.wait(remaining)
             batch: List[_Pending] = []
             rows = 0
+            now = time.monotonic()
             while self._queue and rows + len(self._queue[0].X) <= max_rows:
                 p = self._queue.popleft()
+                if p.deadline is not None and now >= p.deadline:
+                    self._queue_rows -= len(p.X)
+                    expired.append(p)
+                    continue
                 rows += len(p.X)
                 batch.append(p)
             if not batch and self._queue:
                 # Head request alone exceeds max_batch (only possible if
                 # someone shrank serve_max_batch at runtime): dispatch it
                 # solo; aot.predict chunks it across max-bucket calls.
-                batch.append(self._queue.popleft())
-                rows = len(batch[0].X)
+                # Same expiry rule as the normal pop — an oversized
+                # request is not a license to dispatch a dead caller.
+                p = self._queue.popleft()
+                if p.deadline is not None and now >= p.deadline:
+                    self._queue_rows -= len(p.X)
+                    expired.append(p)
+                else:
+                    batch.append(p)
+                    rows = len(p.X)
             self._queue_rows -= rows
             t_taken = time.monotonic()
             for p in batch:
                 p.t_taken = t_taken
-            return batch
+            self._inflight = batch
+            return batch, expired
+
+    def _discard_expired(self, expired: List[_Pending]) -> None:
+        """504 the requests whose deadline passed while queued: error
+        scatter + counter + a trace record of the expiry — the device
+        never saw their rows (the acceptance invariant the deadline
+        chaos test pins via the dispatch counters)."""
+        with _stats_lock:
+            self.stats.deadline_exceeded += len(expired)
+        for p in expired:
+            waited_s = time.monotonic() - p.t_enqueue
+            exc = DeadlineExceeded(self.name, p.budget_ms or 0.0,
+                                   waited_s * 1e3, "queue")
+            if p.ctx is not None and p.ctx.sampled:
+                tracing.record_span(
+                    "deadline.expired", waited_s, ctx=p.ctx,
+                    attrs={"model": self.name, "rows": len(p.X),
+                           "budget_ms": p.budget_ms},
+                    status="error", error=str(exc))
+            p.error = exc
+            p.done.set()
 
     def _loop(self) -> None:
         while True:
-            batch = self._take_batch()
+            batch, expired = self._take_batch()
+            if expired:
+                self._discard_expired(expired)
             if not batch:
                 # Empty means stopped-and-drained OR a timeout
                 # withdrawal emptied the queue during the linger wait —
@@ -351,55 +605,175 @@ class ModelBatcher:
             for p in batch:
                 groups.setdefault(id(p.entry), []).append(p)
             for grp in groups.values():
+                # Outside the per-group try on purpose: a raise here is
+                # a dispatcher CRASH (supervised restart re-queues the
+                # group — the device saw nothing), not a per-request
+                # model error to scatter.
+                failpoints.fire(FP_PRE_DISPATCH)
+                entry = grp[0].entry
+                for p in grp:
+                    p.dispatched = True
                 try:
                     t0 = time.monotonic()
                     X = (grp[0].X if len(grp) == 1
                          else np.concatenate([p.X for p in grp], axis=0))
-                    probs = grp[0].entry.predict(X)
+                    probs = entry.predict(X)
                     t_device = time.monotonic() - t0
-                    off = 0
-                    for p in grp:
-                        p.probs = probs[off:off + len(p.X)]
-                        off += len(p.X)
-                    with _stats_lock:
-                        self.stats.batches += 1
-                        self.stats.batched_rows += off
-                    # One batch.coalesce span per coalesced dispatch
-                    # (recorded into the first traced request's trace),
-                    # linked as PARENT of every co-batched request's
-                    # dispatch.device span: the trace shows N requests
-                    # sharing one device program, and scatter time is
-                    # the coalesce−device gap.
-                    coalesce = time.monotonic() - t0
-                    bsid = None
-                    for p in grp:
-                        if p.ctx is not None and p.ctx.sampled:
-                            bsid = tracing.record_span(
-                                "batch.coalesce", coalesce, ctx=p.ctx,
-                                attrs={"model": self.name,
-                                       "requests": len(grp), "rows": off})
-                            break
-                    for p in grp:
-                        if p.ctx is not None and p.ctx.sampled:
-                            tracing.record_span(
-                                "dispatch.device", t_device, ctx=p.ctx,
-                                parent_id=bsid,
-                                attrs={"co_batched": len(grp),
-                                       "batch_rows": off})
                 except Exception as exc:  # noqa: BLE001 — scattered per req
                     with _stats_lock:
                         self.stats.errors += len(grp)
                     for p in grp:
                         p.error = exc
+                        p.done.set()
+                    continue
+                # A raise here crashes the dispatcher AFTER the device
+                # computed: supervision fails the group 503 (re-running
+                # it would double-spend device time) — the asymmetry the
+                # pre/mid chaos pair exists to prove.
+                failpoints.fire(FP_MID_DISPATCH)
+                try:
+                    self._scatter(grp, probs, t0, t_device)
                 finally:
                     for p in grp:
                         p.done.set()
+            self._inflight = []
+            # A clean batch ends any crash streak — quarantine is for
+            # models that cannot dispatch at all, not ones that crashed
+            # transiently N times over a whole process lifetime.
+            self._crashes = 0
+
+    def _scatter(self, grp: List[_Pending], probs: np.ndarray,
+                 t0: float, t_device: float) -> None:
+        """Scatter one dispatched group's results (or a scatter-side
+        error) back to its requests. Its own except keeps the old
+        contract: ANY failure after the device ran still hands every
+        request a typed error — completing a request with neither probs
+        nor error would surface as an opaque 500 downstream."""
+        try:
+            off = 0
+            for p in grp:
+                p.probs = probs[off:off + len(p.X)]
+                off += len(p.X)
+            with _stats_lock:
+                self.stats.batches += 1
+                self.stats.batched_rows += off
+                self.stats.observe_dispatch(off, t_device)
+            # One batch.coalesce span per coalesced dispatch
+            # (recorded into the first traced request's trace),
+            # linked as PARENT of every co-batched request's
+            # dispatch.device span: the trace shows N requests
+            # sharing one device program, and scatter time is
+            # the coalesce−device gap.
+            coalesce = time.monotonic() - t0
+            bsid = None
+            for p in grp:
+                if p.ctx is not None and p.ctx.sampled:
+                    bsid = tracing.record_span(
+                        "batch.coalesce", coalesce, ctx=p.ctx,
+                        attrs={"model": self.name,
+                               "requests": len(grp), "rows": off})
+                    break
+            for p in grp:
+                if p.ctx is not None and p.ctx.sampled:
+                    tracing.record_span(
+                        "dispatch.device", t_device, ctx=p.ctx,
+                        parent_id=bsid,
+                        attrs={"co_batched": len(grp),
+                               "batch_rows": off})
+        except Exception as exc:  # noqa: BLE001 — scattered per req
+            with _stats_lock:
+                self.stats.errors += len(grp)
+            for p in grp:
+                p.error = exc
+
+    # -- supervision ---------------------------------------------------------
+
+    def _run(self) -> None:
+        """The dispatcher thread body: `_loop` under supervision. A
+        crash (exception escaping the loop — the class that used to
+        black-hole the model until process restart) restarts the loop
+        under exponential backoff; `serve_quarantine_crashes`
+        consecutive crashes quarantine the model instead."""
+        while True:
+            try:
+                self._loop()
+                return                      # stopped and drained
+            except Exception as exc:  # noqa: BLE001 — supervised boundary
+                if not self._survive_crash(exc):
+                    return
+
+    def _survive_crash(self, exc: Exception) -> bool:
+        """Handle one dispatcher crash; True = restart the loop."""
+        log.error("dispatcher for model %s crashed: %s: %s",
+                  self.name, type(exc).__name__, exc, exc_info=exc)
+        inflight = [p for p in self._inflight if not p.done.is_set()]
+        self._inflight = []
+        requeue = [p for p in inflight if not p.dispatched]
+        lost = [p for p in inflight if p.dispatched]
+        self._crashes += 1
+        with _stats_lock:
+            self.stats.dispatcher_restarts += 1
+        threshold = max(1, int(self.cfg.serve_quarantine_crashes))
+        if self._crashes >= threshold:
+            with self._cond:
+                self._quarantined = (
+                    f"model {self.name} quarantined after {self._crashes} "
+                    f"consecutive dispatcher crashes "
+                    f"(last: {type(exc).__name__}: {exc}); DELETE or "
+                    "re-save the model to lift the quarantine")
+                leftovers = list(self._queue)
+                self._queue.clear()
+                self._queue_rows = 0
+            with _stats_lock:
+                self.stats.quarantined = 1
+            log.error("%s", self._quarantined)
+            qerr = ModelQuarantined(self._quarantined)
+            for p in requeue + lost + leftovers:
+                p.error = qerr
+                p.done.set()
+            return False
+        # Already-dispatched requests lost their results with the crash;
+        # re-running them would double-spend device time — fail them 503
+        # (the client's backoff retries against the restarted loop).
+        cerr = DispatcherCrashed(
+            f"predict dispatcher for model {self.name} crashed mid-batch "
+            f"({type(exc).__name__}: {exc}); dispatcher restarting — retry")
+        for p in lost:
+            p.error = cerr
+            p.done.set()
+        with self._cond:
+            if self._stopped:
+                # stop() raced the crash: it is joining this thread and
+                # will fail whatever remains queued; don't re-queue onto
+                # a dispatcher that is never coming back.
+                for p in requeue:
+                    p.error = BatcherStopped(
+                        f"predict dispatcher for model {self.name} stopped")
+                    p.done.set()
+                return False
+            # The device never saw these rows: put them back at the
+            # FRONT in their original order so the restarted loop serves
+            # them first — a stock client completes without even a
+            # retry.
+            for p in reversed(requeue):
+                self._queue.appendleft(p)
+                self._queue_rows += len(p.X)
+        backoff = min(_RESTART_BACKOFF_CAP_S,
+                      float(self.cfg.serve_restart_backoff_s)
+                      * (2 ** (self._crashes - 1)))
+        log.warning("restarting dispatcher for model %s in %.2fs "
+                    "(crash %d/%d before quarantine)",
+                    self.name, backoff, self._crashes, threshold)
+        if self._stopping.wait(backoff):
+            return False                   # stop() interrupted the backoff
+        return True
 
     def stop(self) -> None:
+        self._stopping.set()
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
-        self._thread.join(timeout=5.0)
+        self._thread.join(timeout=_RESTART_BACKOFF_CAP_S + 5.0)
         # Fail anything still queued so no handler thread waits out its
         # full timeout against a dead worker.
         with self._cond:
@@ -430,6 +804,12 @@ class PredictBatcher:
         self._batchers: Dict[str, ModelBatcher] = {}
         self._stats: Dict[str, _Stats] = {}
         self._stopped = False
+        #: Requests currently inside :meth:`predict` — including the
+        #: handler phase (design build, first-touch compile) BEFORE the
+        #: rows reach any queue. The drain quiesce probe must count
+        #: these too: stopping the dispatchers while an accepted request
+        #: is still preprocessing would 503 it mid-drain.
+        self._active = 0
 
     def _batcher(self, name: str) -> ModelBatcher:
         with self._lock:
@@ -439,6 +819,10 @@ class PredictBatcher:
                 raise BatcherStopped(
                     f"predict tier stopped; model {name} not served")
             b = self._batchers.get(name)
+            if b is not None:
+                reason = b.quarantined()
+                if reason:
+                    raise ModelQuarantined(reason)
             if b is None:
                 # Re-validate before spawning a dispatcher: a request
                 # racing DELETE can reach here after invalidate()
@@ -447,14 +831,56 @@ class PredictBatcher:
                 # can never serve again.
                 self.aot.registry.version(name)   # ModelNotFound → 404
                 stats = self._stats.setdefault(name, _Stats())
+                with _stats_lock:
+                    # A fresh dispatcher (post-DELETE/re-save) lifts any
+                    # previous quarantine; the counter history survives.
+                    stats.quarantined = 0
                 b = ModelBatcher(name, self.cfg, stats)
                 self._batchers[name] = b
             return b
 
-    def predict(self, name: str, rows: Sequence[Any]) -> Dict[str, Any]:
+    def predict(self, name: str, rows: Sequence[Any],
+                deadline_ms: Optional[float] = None) -> Dict[str, Any]:
         """The whole handler shim: rows → design matrix (host-side, on
         the handler thread so feature prep overlaps other models'
-        device work) → enqueue/await → JSON-able result."""
+        device work) → enqueue/await → JSON-able result.
+
+        ``deadline_ms`` is the caller's remaining end-to-end budget; the
+        clock starts HERE (so design-build time counts against it), and
+        expiry anywhere downstream raises :class:`DeadlineExceeded`
+        (→ terminal 504)."""
+        with self._lock:
+            self._active += 1
+        try:
+            return self._predict(name, rows, deadline_ms)
+        finally:
+            with self._lock:
+                self._active -= 1
+
+    def _predict(self, name: str, rows: Sequence[Any],
+                 deadline_ms: Optional[float]) -> Dict[str, Any]:
+        deadline = budget_ms = None
+        if deadline_ms is not None:
+            if deadline_ms <= 0:
+                # The budget arrived already spent: terminal 504 —
+                # counted and traced like any other miss, so a client
+                # burning 100% of its requests this way still moves
+                # lo_serving_deadline_exceeded_total and the rate alert.
+                self.aot.registry.version(name)   # unknown model → 404
+                with self._lock:
+                    stats = self._stats.setdefault(name, _Stats())
+                with _stats_lock:
+                    stats.deadline_exceeded += 1
+                exc = DeadlineExceeded(name, float(deadline_ms), 0.0,
+                                       "admission")
+                tracing.record_span(
+                    "deadline.rejected", 0.0,
+                    attrs={"model": name,
+                           "budget_ms": float(deadline_ms)},
+                    status="error", error=str(exc))
+                raise exc
+            budget_ms = float(deadline_ms)
+            deadline = time.monotonic() + budget_ms / 1e3
         if int(self.cfg.serve_queue_depth) <= 0:
             # Existence check BEFORE creating a stats slot: _stats
             # entries are permanent (invalidate() keeps them for
@@ -470,6 +896,15 @@ class PredictBatcher:
             with _stats_lock:
                 stats.rejected += 1
             raise QueueFull(name, 0)
+        # Quarantine check BEFORE any per-request work: a quarantined
+        # model's terminal 503 should cost a dict lookup, not a design
+        # build (the _batcher() re-check still guards the race).
+        with self._lock:
+            b = self._batchers.get(name)
+        if b is not None:
+            reason = b.quarantined()
+            if reason:
+                raise ModelQuarantined(reason)
         # Load/compile (and 404/406) BEFORE enqueueing: a bad model name
         # must not cost a queue slot, and first-touch compile happens on
         # the handler thread instead of stalling the dispatch loop.
@@ -501,7 +936,8 @@ class PredictBatcher:
         # come from the dispatcher (ModelBatcher._loop).
         tracing.record_span("design.build", time.monotonic() - t0,
                             attrs={"model": name, "rows": len(rows)})
-        probs = self._batcher(name).submit(X, entry)
+        probs = self._batcher(name).submit(X, entry, deadline=deadline,
+                                           budget_ms=budget_ms)
         # .tolist() (C-speed) — this runs per request on the hot path.
         return {
             "model": name,
@@ -515,29 +951,55 @@ class PredictBatcher:
     def invalidate(self, name: Optional[str] = None) -> None:
         """Drop compiled programs (and the dispatcher thread) for a
         deleted/re-saved model; stats survive so /metrics history does
-        not reset."""
+        not reset — except the quarantined LEVEL, which this call is
+        the documented lift for: a DELETEd model never creates another
+        batcher, so clearing it only on batcher re-creation would pin
+        the gauge (and the serving_quarantined alert) at 1 forever."""
         self.aot.invalidate(name)
         with self._lock:
             if name is None:
                 doomed = list(self._batchers.values())
                 self._batchers.clear()
+                cleared = list(self._stats.values())
             else:
                 b = self._batchers.pop(name, None)
                 doomed = [b] if b is not None else []
+                st = self._stats.get(name)
+                cleared = [st] if st is not None else []
         for b in doomed:
             b.stop()
+        with _stats_lock:
+            for st in cleared:
+                st.quarantined = 0
 
     def health(self) -> Dict[str, Any]:
         """Dispatcher-thread liveness for ``GET /healthz``: a model whose
         dispatcher thread died without being stopped would black-hole
         its requests — the silent failure mode the deep health rollup
-        exists to surface."""
+        exists to surface. Quarantined models are listed (they answer a
+        mapped terminal 503, so they don't flip ``ok`` — the
+        ``serving_quarantined`` alert carries the paging signal)."""
         with self._lock:
             batchers = dict(self._batchers)
         dead = sorted(n for n, b in batchers.items()
                       if not b.thread_alive())
+        quarantined = sorted(n for n, b in batchers.items()
+                             if b.quarantined())
         return {"ok": not dead, "dispatchers": len(batchers),
-                "dead": dead}
+                "dead": dead, "quarantined": quarantined}
+
+    def quiesced(self) -> bool:
+        """True when no request is anywhere inside the tier — neither
+        in :meth:`predict`'s handler phase (design build / first-touch
+        compile, before any queue) nor queued/in-flight on a dispatcher
+        — the drain loop's completion probe (new work is gated off
+        upstream while draining, so this only ever goes to True and
+        stays)."""
+        with self._lock:
+            if self._active > 0:
+                return False
+            batchers = list(self._batchers.values())
+        return all(b.outstanding() == 0 for b in batchers)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -553,6 +1015,11 @@ class PredictBatcher:
             "rejected": sum(m["rejected"] for m in models.values()),
             "timeouts": sum(m["timeouts"] for m in models.values()),
             "errors": sum(m["errors"] for m in models.values()),
+            "deadline_exceeded": sum(m["deadline_exceeded"]
+                                     for m in models.values()),
+            "dispatcher_restarts": sum(m["dispatcher_restarts"]
+                                       for m in models.values()),
+            "quarantined": sum(m["quarantined"] for m in models.values()),
             "queue_rows": sum(m["queue_rows"] for m in models.values()),
             "qps": round(sum(m["qps"] for m in models.values()), 3),
         }

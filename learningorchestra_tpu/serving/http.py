@@ -21,12 +21,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from learningorchestra_tpu.utils import tracing
+from learningorchestra_tpu.utils import failpoints, tracing
 
 #: Inbound X-Request-Id values become trace ids verbatim when they look
 #: like ids; anything else (oversized, control chars, header-injection
 #: attempts) is replaced with a fresh id rather than propagated.
 _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+#: Chaos seam at the response-write boundary — the handler computed an
+#: answer the client may never (or only very late) receive. raise-mode
+#: proves the error path still answers (one-shot re-entry); slow/hang
+#: exercise client-side read timeouts against a committed server.
+FP_PRE_RESPONSE = failpoints.declare("serving.http.pre_response")
 
 
 class HttpError(Exception):
@@ -227,6 +233,7 @@ def _make_handler(router: Router, request_timeout_s: Optional[float] = None):
         def _send_bytes(self, status: int, content_type: str,
                         data: bytes,
                         headers: Optional[Dict[str, str]] = None) -> None:
+            failpoints.fire(FP_PRE_RESPONSE)
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
@@ -238,6 +245,14 @@ def _make_handler(router: Router, request_timeout_s: Optional[float] = None):
                 self.send_header("X-Request-Id", rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
+                if k.lower() == "connection" and v.lower() == "close":
+                    # Honor an explicit Connection: close (the draining
+                    # 503 sends one): mark the keep-alive connection for
+                    # teardown after this response so a draining server
+                    # sheds its persistent connections instead of
+                    # re-answering 503 on each until the socket times
+                    # out.
+                    self.close_connection = True
             self.end_headers()
             self.wfile.write(data)
 

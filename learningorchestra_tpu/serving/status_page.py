@@ -33,7 +33,9 @@ th { background: #eceff6; }
 
 _STATUS_CLASS = {"done": "done", "failed": "failed",
                  "running": "running", "queued": "queued",
-                 "firing": "failed", "ok": "done"}
+                 "firing": "failed", "ok": "done",
+                 "quarantined": "failed", "draining": "queued",
+                 "serving": "done"}
 
 
 def _fmt_bytes(n: Any) -> str:
@@ -73,6 +75,7 @@ def _serving_section(serving: Optional[Dict[str, Any]]) -> str:
     for name, m in sorted((serving.get("models") or {}).items()):
         rows.append([
             escape(str(name)),
+            _badge("quarantined" if m.get("quarantined") else "ok"),
             escape(str(m.get("requests", 0))),
             escape(str(m.get("qps", 0))),
             escape(str(m.get("mean_batch_rows", 0))),
@@ -80,9 +83,12 @@ def _serving_section(serving: Optional[Dict[str, Any]]) -> str:
             escape("" if m.get("p50_ms") is None else str(m["p50_ms"])),
             escape("" if m.get("p99_ms") is None else str(m["p99_ms"])),
             escape(str(m.get("rejected", 0))),
+            escape(str(m.get("deadline_exceeded", 0))),
+            escape(str(m.get("dispatcher_restarts", 0))),
         ])
-    table = _table(["model", "requests", "qps", "rows/batch", "queue",
-                    "p50 (ms)", "p99 (ms)", "rejected (503)"], rows)
+    table = _table(["model", "state", "requests", "qps", "rows/batch",
+                    "queue", "p50 (ms)", "p99 (ms)", "rejected (503)",
+                    "expired (504)", "restarts"], rows)
     return (f"<h2>Online predict ({len(rows)} models)</h2>"
             f"<p>{agg}</p>{table}")
 

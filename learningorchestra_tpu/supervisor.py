@@ -143,6 +143,12 @@ class Supervisor:
         self.fallback_server = None
         self._procs: List[subprocess.Popen] = []
         self._stop = threading.Event()
+        #: Planned rolling restart requested (SIGHUP / tests): children
+        #: are SIGTERMed and given the full graceful-drain window
+        #: (LO_TPU_DRAIN_TIMEOUT_S — the server finishes its accepted
+        #: requests behind its drain gate) before SIGKILL; consumes no
+        #: restart budget and advances the mesh epoch like any restart.
+        self._planned = threading.Event()
 
     # -- shared mesh-epoch file ----------------------------------------------
 
@@ -187,14 +193,21 @@ class Supervisor:
         log.info("spawned %d pod process(es) at mesh epoch %d",
                  len(self._procs), self.epoch)
 
-    def _kill_all(self) -> None:
+    def _kill_all(self, grace_s: Optional[float] = None) -> None:
+        """SIGTERM every child, escalate to SIGKILL after ``grace_s``
+        (default: the crash-path TERM_GRACE_S). The planned-restart path
+        passes the graceful-drain window instead — SIGTERM triggers the
+        server's drain (serving/__main__.py), and killing it mid-drain
+        would drop exactly the accepted requests the drain exists to
+        finish."""
         for p in self._procs:
             if p.poll() is None:
                 try:
                     p.terminate()
                 except OSError:
                     pass
-        deadline = time.time() + self.TERM_GRACE_S
+        deadline = time.time() + (self.TERM_GRACE_S if grace_s is None
+                                  else grace_s)
         for p in self._procs:
             while p.poll() is None and time.time() < deadline:
                 time.sleep(0.05)
@@ -204,6 +217,12 @@ class Supervisor:
                 except OSError:
                     pass
                 p.wait()
+
+    def request_planned_restart(self) -> None:
+        """Ask for a graceful rolling restart (wired to SIGHUP in
+        ``main``): drain-then-restart under a fresh mesh epoch, zero
+        accepted requests lost, zero restart budget consumed."""
+        self._planned.set()
 
     def request_stop(self) -> None:
         """Stop supervising: kill the children and end ``run()`` (tests,
@@ -241,6 +260,22 @@ class Supervisor:
         self._spawn_all()
         next_health = time.time() + self.cfg.health_interval_s
         while not self._stop.is_set():
+            if self._planned.is_set():
+                self._planned.clear()
+                log.info("planned rolling restart at epoch %d: draining "
+                         "children (up to %.0fs)", self.epoch,
+                         self.cfg.drain_timeout_s)
+                # SIGTERM → the server drains (finishes accepted work,
+                # rejects new 503) → exits; escalate only past the drain
+                # window plus the usual grace. Not an incident: no
+                # budget, no backoff — but a fresh epoch, like any
+                # restart, so stale workers are turned away.
+                self._kill_all(
+                    grace_s=self.cfg.drain_timeout_s + self.TERM_GRACE_S)
+                self._advance_epoch()
+                next_health = time.time() + self.cfg.health_interval_s
+                self._spawn_all()
+                continue
             codes = [p.poll() for p in self._procs]
             if all(c == 0 for c in codes):
                 log.info("all pod processes exited cleanly")
@@ -385,6 +420,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                      fallback_port=args.fallback_port)
     signal.signal(signal.SIGTERM, lambda *_: sup.request_stop())
     signal.signal(signal.SIGINT, lambda *_: sup.request_stop())
+    # SIGHUP = planned rolling restart: children drain gracefully (zero
+    # accepted requests lost), then respawn under the next mesh epoch.
+    signal.signal(signal.SIGHUP, lambda *_: sup.request_planned_restart())
     rc = sup.run()
     if rc != 0 and sup.fallback_server is not None:
         # Stay up serving the failure report until SIGTERM/SIGINT (the

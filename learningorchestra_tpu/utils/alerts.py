@@ -265,6 +265,41 @@ def _reject_rate(snapshot: Dict[str, Any],
     return (d_rej / offered) if offered > 0 else 0.0
 
 
+def _deadline_rate(snapshot: Dict[str, Any],
+                   state: Dict[str, Any]) -> Optional[float]:
+    """Per-window deadline-expired / offered ratio for the online
+    predict tier — sustained misses mean callers are abandoning answers
+    faster than the tier can produce them. Offered = completed +
+    expired this window; an idle window reads 0.0 (no data ≠ bad)."""
+    serving = snapshot.get("serving") or {}
+    ded = serving.get("deadline_exceeded")
+    req = serving.get("requests")
+    if not isinstance(ded, (int, float)) or not isinstance(
+            req, (int, float)):
+        return None
+    prev = state.get("prev")
+    state["prev"] = (float(ded), float(req))
+    if prev is None:
+        return None
+    d_ded = max(0.0, float(ded) - prev[0])
+    d_req = max(0.0, float(req) - prev[1])
+    offered = d_ded + d_req
+    return (d_ded / offered) if offered > 0 else 0.0
+
+
+def _quarantined_models(snapshot: Dict[str, Any],
+                        _state: Dict[str, Any]) -> Optional[float]:
+    """How many models are currently quarantined (dispatcher crashed
+    past its threshold and predicts answer the terminal 503). Level, not
+    delta: the alert stays FIRING for as long as any quarantine stands,
+    and resolves when a DELETE/re-save lifts the last one."""
+    serving = snapshot.get("serving") or {}
+    q = serving.get("quarantined")
+    if not isinstance(q, (int, float)) or isinstance(q, bool):
+        return None
+    return float(q)
+
+
 def _pod_degraded(snapshot: Dict[str, Any],
                   _state: Dict[str, Any]) -> Optional[float]:
     pod = snapshot.get("pod") or {}
@@ -292,6 +327,21 @@ def default_rules(cfg: Settings) -> List[AlertRule]:
             summary="predict queue rejecting a sustained fraction of "
                     "offered requests (capacity, not a blip)",
             sample=_reject_rate, threshold=float(cfg.slo_reject_rate)))
+    if cfg.slo_deadline_rate > 0:
+        rules.append(AlertRule(
+            name="serving_deadline_exceeded_rate", severity="warning",
+            summary="a sustained fraction of predict requests is dying "
+                    "at its deadline (admission or in-queue expiry) — "
+                    "callers abandon answers faster than the tier "
+                    "produces them",
+            sample=_deadline_rate,
+            threshold=float(cfg.slo_deadline_rate)))
+    rules.append(AlertRule(
+        name="serving_quarantined", severity="warning",
+        summary="a model's dispatcher crashed past its quarantine "
+                "threshold; its predicts answer a terminal 503 until "
+                "the model is re-saved or deleted",
+        sample=_quarantined_models, threshold=0.5, for_windows=1))
     rules.append(AlertRule(
         name="pod_degraded", severity="critical",
         summary="a pod worker died mid-job; mesh jobs fail fast until "

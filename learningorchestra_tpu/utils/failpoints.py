@@ -19,11 +19,17 @@ with modes
 - ``hang``    — block ~1 hour (wedge detection / timeout paths);
 - ``torn``    — truncate the in-flight file named by ``path`` to half
   its bytes (a torn write that later surfaces as corruption);
-- ``bitflip`` — flip one bit mid-file in ``path`` (bit rot).
+- ``bitflip`` — flip one bit mid-file in ``path`` (bit rot);
+- ``slow``    — sleep :data:`SLOW_S` seconds (a stall long enough to
+  breach any realistic deadline budget without wedging the suite the
+  way ``hang`` would — the serving deadline chaos tests lean on it).
 
 ``nth`` (default 1) arms the site on its Nth hit — one-shot: after
 firing, the site deactivates, so a recovery path re-entering the same
-code cannot re-trip it.
+code cannot re-trip it. ``nth`` of **0** arms the site PERSISTENTLY —
+it fires on *every* hit and never deactivates: how the dispatcher
+quarantine chaos test makes a supervised restart crash again on each
+attempt.
 
 Zero overhead when unset: ``fire`` is a single attribute test on a
 module-level flag that is False unless the env var (or ``configure``)
@@ -46,7 +52,12 @@ ENV_VAR = "LO_TPU_FAILPOINTS"
 #: killed the child.
 CRASH_EXIT_CODE = 41
 
-_MODES = ("raise", "crash", "hang", "torn", "bitflip")
+_MODES = ("raise", "crash", "hang", "torn", "bitflip", "slow")
+
+#: ``slow`` mode's stall length — long past any sane request deadline
+#: budget, short enough that a test leaking one costs seconds, not the
+#: suite timeout.
+SLOW_S = 2.0
 
 
 class FailpointError(RuntimeError):
@@ -107,8 +118,9 @@ def parse_spec(spec: str) -> Dict[str, _Armed]:
             raise ValueError(
                 f"unknown failpoint mode {mode!r} (want one of {_MODES})")
         nth = int(nth_s) if nth_s else 1
-        if nth < 1:
-            raise ValueError(f"failpoint nth must be >= 1, got {nth}")
+        if nth < 0:
+            raise ValueError(
+                f"failpoint nth must be >= 0 (0 = every hit), got {nth}")
         out[site.strip()] = _Armed(mode, nth)
     return out
 
@@ -183,7 +195,8 @@ def fire(site: str, path: Optional[str] = None) -> None:
         armed.hits += 1
         if armed.hits < armed.nth:
             return
-        armed.fired = True
+        if armed.nth > 0:                 # nth=0 = persistent: every hit
+            armed.fired = True
         mode = armed.mode
     if mode == "crash":
         # Skip interpreter teardown entirely — the point is the state
@@ -191,6 +204,9 @@ def fire(site: str, path: Optional[str] = None) -> None:
         os._exit(CRASH_EXIT_CODE)
     if mode == "hang":
         time.sleep(3600.0)
+        return
+    if mode == "slow":
+        time.sleep(SLOW_S)
         return
     if mode in ("torn", "bitflip") and path is not None \
             and os.path.isfile(path):

@@ -143,13 +143,21 @@ def render(doc: Dict[str, Any]) -> str:
     serving = doc.get("serving") or {}
     models = serving.get("models") or {}
     if models:
-        for key in ("requests", "rows", "batches", "rejected",
-                    "timeouts", "errors"):
+        for key in ("requests", "rows", "batches", "batched_rows",
+                    "rejected", "timeouts", "errors", "deadline_exceeded",
+                    "dispatcher_restarts"):
             name = f"lo_serving_{key}_total"
             w.header(name, _COUNTER,
                      f"Online predict tier {key} per model")
             for model, m in sorted(models.items()):
                 w.sample(name, {"model": model}, m.get(key, 0))
+        # quarantined is a LEVEL (0/1 per model), not a monotone count.
+        w.header("lo_serving_quarantined", _GAUGE,
+                 "1 while the model is quarantined (dispatcher crashed "
+                 "past its threshold; predicts answer a terminal 503)")
+        for model, m in sorted(models.items()):
+            w.sample("lo_serving_quarantined", {"model": model},
+                     m.get("quarantined", 0))
         for key in ("queue_rows", "qps", "mean_batch_rows"):
             name = f"lo_serving_{key}"
             w.header(name, _GAUGE,

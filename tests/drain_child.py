@@ -1,0 +1,75 @@
+"""Child process for the graceful-drain chaos test (SIGTERM under load).
+
+Builds one tiny online-servable model, serves it over real HTTP, wires
+the PRODUCTION graceful-shutdown signal path
+(serving.__main__.install_graceful_shutdown), prints its port as a JSON
+line, and parks on the stopped event exactly like ``python -m
+learningorchestra_tpu.serving`` does. The parent test drives a
+closed-loop client storm, SIGTERMs this process mid-flight, and asserts
+zero accepted requests were dropped, /healthz reported ``draining``
+during the window, and the process exited within LO_TPU_DRAIN_TIMEOUT_S.
+
+Chaos shaping comes from the parent via LO_TPU_FAILPOINTS (e.g.
+``serving.batcher.pre_dispatch=slow:3`` to hold a dispatch mid-storm so
+the drain window is observably non-empty).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from learningorchestra_tpu.config import Settings  # noqa: E402
+from learningorchestra_tpu.serving.__main__ import (  # noqa: E402
+    install_graceful_shutdown)
+from learningorchestra_tpu.serving.app import App  # noqa: E402
+
+
+def main() -> int:
+    root = sys.argv[1]
+    cfg = Settings()
+    cfg.store_root = os.path.join(root, "store")
+    cfg.image_root = os.path.join(root, "images")
+    cfg.port = 0
+    cfg.persist = False
+    cfg.serve_max_batch = 16
+
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(7)
+    n = 80
+    ds = app.store.create("dtrain")
+    x = rng.normal(size=n)
+    ds.append_columns({
+        "x": x, "y": rng.normal(size=n),
+        "label": (x > 0).astype(np.int64)})
+    app.store.finish("dtrain")
+    app.builder.build("dtrain", "dtrain", "dm", ["nb"], "label")
+    # Warm the AOT ladder so the storm measures serving, not compiles.
+    app.predictor.predict("dm_nb", [[0.1, 0.2]])
+
+    server = app.serve(background=True)
+    stopped = install_graceful_shutdown(app, server)
+    print(json.dumps({"port": server.port}), flush=True)
+    stopped.wait()
+    # Post-drain report the parent asserts on: every accepted predict
+    # was answered (queues quiesced) before the server stopped.
+    print(json.dumps({
+        "exited": True,
+        "quiesced": app.predictor.quiesced(),
+        "running_jobs": app.jobs.running_count(),
+        "serving": {k: v for k, v in app.predictor.snapshot().items()
+                    if k in ("requests", "rejected", "errors",
+                             "timeouts", "deadline_exceeded")},
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

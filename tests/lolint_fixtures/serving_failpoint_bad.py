@@ -1,0 +1,15 @@
+"""FIRING fixture for failpoint-coverage's serving/ scope: device
+dispatch and response writes the chaos tests cannot wedge or crash."""
+
+
+class Dispatcher:
+    def dispatch(self, grp, X):
+        entry = grp[0].entry
+        return entry.predict(X)         # device dispatch, no fire() seam
+
+
+class Handler:
+    wfile = None
+
+    def send(self, data):
+        self.wfile.write(data)          # response write, no fire() seam

@@ -1,0 +1,38 @@
+"""NON-FIRING fixture for failpoint-coverage's serving/ scope: every
+device-dispatch / response-write seam carries a declared site, and
+facade calls that merely END in ``predict`` are not triggers."""
+
+from learningorchestra_tpu.utils import failpoints
+
+FP_PRE_DISPATCH = failpoints.declare("test.fixture.serving.pre_dispatch")
+FP_PRE_RESPONSE = failpoints.declare("test.fixture.serving.pre_response")
+
+
+class Dispatcher:
+    def dispatch(self, grp, X):
+        failpoints.fire(FP_PRE_DISPATCH)
+        entry = grp[0].entry
+        return entry.predict(X)
+
+
+class Handler:
+    wfile = None
+
+    def send(self, data):
+        failpoints.fire(FP_PRE_RESPONSE)
+        self.wfile.write(data)
+
+
+class Facade:
+    predictor = None
+    reentry = None
+
+    def route(self, name, rows):
+        # A facade's .predict() is an enqueue shim, not device dispatch:
+        # must not require a failpoint seam.
+        return self.predictor.predict(name, rows)
+
+    def lookalike(self, X):
+        # Attribute-boundary check: `reentry.predict` merely ENDS in
+        # the trigger's characters — not a seam.
+        return self.reentry.predict(X)

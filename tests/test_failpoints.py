@@ -84,12 +84,40 @@ def test_parse_spec_and_errors():
     assert armed["a.b"].mode == "raise" and armed["a.b"].nth == 1
     assert armed["c.d"].mode == "crash" and armed["c.d"].nth == 3
     assert armed["e.f"].mode == "bitflip"
+    # nth=0 = persistent (fires on EVERY hit — the serving quarantine
+    # chaos test's re-crash-after-restart arming, PR 11).
+    assert failpoints.parse_spec("g.h=raise:0")["g.h"].nth == 0
     with pytest.raises(ValueError, match="unknown failpoint mode"):
         failpoints.parse_spec("a=explode")
     with pytest.raises(ValueError, match="site=mode"):
         failpoints.parse_spec("justasite")
-    with pytest.raises(ValueError, match=">= 1"):
-        failpoints.parse_spec("a=raise:0")
+    with pytest.raises(ValueError, match=">= 0"):
+        failpoints.parse_spec("a=raise:-1")
+
+
+def test_persistent_and_slow_modes():
+    """nth=0 keeps firing across hits (never one-shots); slow mode
+    sleeps SLOW_S instead of raising."""
+    site = failpoints.declare("test.unit.persistent")
+    failpoints.configure(f"{site}=raise:0")
+    for _ in range(3):
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fire(site)
+    import time as _time
+
+    slow_site = failpoints.declare("test.unit.slow")
+    failpoints.configure(f"{slow_site}=slow")
+    old = failpoints.SLOW_S
+    failpoints.SLOW_S = 0.05
+    try:
+        t0 = _time.monotonic()
+        failpoints.fire(slow_site)          # sleeps, returns, no raise
+        assert _time.monotonic() - t0 >= 0.04
+        t0 = _time.monotonic()
+        failpoints.fire(slow_site)          # one-shot: spent, instant
+        assert _time.monotonic() - t0 < 0.04
+    finally:
+        failpoints.SLOW_S = old
 
 
 def test_disarmed_fire_is_a_noop_and_nth_is_oneshot():

@@ -87,6 +87,30 @@ def test_lock_blocking_names_the_lock_and_call():
     assert any(".save()" in m and "registry_lock" in m for m in blurbs)
 
 
+def test_failpoint_coverage_serving_scope():
+    """The rule's serving/ extension: device-dispatch (entry.predict)
+    and response-write (wfile.write) seams must carry a fire() site;
+    facade .predict() calls are not triggers (PR 11)."""
+    (rule,) = rules_by_name(["failpoint-coverage"])
+    relpath = "learningorchestra_tpu/serving/fx.py"
+    assert rule.applies(relpath)
+
+    bad = parse_source(_fixture("serving_failpoint", "bad"), relpath)
+    finds = list(rule.check(bad))
+    msgs = "\n".join(f.message for f in finds)
+    assert len(finds) == 2, finds
+    assert "entry.predict()" in msgs and "wfile.write()" in msgs
+
+    good = parse_source(_fixture("serving_failpoint", "good"), relpath)
+    assert list(rule.check(good)) == []
+
+    # The catalog scope must be untouched by the serving triggers: a
+    # catalog file calling entry.predict is not a dispatch seam.
+    cat = parse_source(_fixture("serving_failpoint", "bad"),
+                       "learningorchestra_tpu/catalog/fx.py")
+    assert list(rule.check(cat)) == []
+
+
 # -- finalize (whole-project) passes -----------------------------------------
 
 def _project_with(tmp_path, relpath, source):

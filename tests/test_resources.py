@@ -341,7 +341,10 @@ def test_resources_endpoint_and_client_passthroughs(served):
     assert "rules" in al and "pod_degraded" in al["rules"]
     hz = obs.healthz()
     assert hz["healthy"] is True
-    assert set(hz["checks"]) == {"pod", "disk", "dispatchers", "alerts"}
+    assert set(hz["checks"]) == {"pod", "disk", "dispatchers",
+                                 "lifecycle", "alerts"}
+    assert hz["state"] == "serving"
+    assert hz["checks"]["lifecycle"]["state"] == "serving"
 
 
 def test_client_healthz_degraded_names_alerts(tmp_path):

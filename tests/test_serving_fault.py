@@ -1,0 +1,639 @@
+"""Serving fault domain (PR 11): end-to-end deadlines, graceful drain,
+dispatcher self-healing/quarantine, and chaos coverage for the predict
+path.
+
+The load-bearing guarantees under test:
+
+- a request whose deadline budget expires in queue gets a terminal 504
+  (never a retryable 503), its rows are NEVER dispatched to the device
+  (pinned via the dispatch counters), and the expiry lands on its trace;
+- admission control rejects up front when predicted queue wait (depth ×
+  recent per-row service rate) exceeds the remaining budget, and the
+  computed Retry-After on QueueFull moves with queue depth;
+- a crashed dispatcher thread (the PR 6 silent-death class) restarts
+  under supervision with its un-dispatched batch re-queued — a stock
+  client completes with no process restart — and repeated crashes
+  quarantine the model (terminal 503 naming it + firing alert);
+- graceful drain: new work 503s with Retry-After + Connection: close,
+  accepted work completes (zero loss), /healthz reports ``draining``;
+  the SIGTERM chaos variant drives the production signal path through a
+  child process (slow lane);
+- each new failpoint site (serving.batcher.pre_dispatch/mid_dispatch,
+  serving.aot.pre_compile, serving.http.pre_response) has a fast
+  raise-mode smoke riding tier-1.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu.client import Context, DeadlineExpired, Model
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.serving import batcher as batcher_mod
+from learningorchestra_tpu.serving.batcher import (
+    DeadlineExceeded, ModelBatcher, QueueFull, _Stats)
+from learningorchestra_tpu.utils import failpoints
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "drain_child.py")
+
+ROW = {"Sex": "male", "Age": 30, "Pclass": 3, "Fare": 7.5}
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture(scope="module")
+def fault(tmp_path_factory):
+    """Live in-process server with two cheap online models and fault
+    knobs tuned for fast tests: tiny supervised-restart backoff, a
+    3-crash quarantine threshold, and alert windows evaluating on every
+    read."""
+    from learningorchestra_tpu.serving.app import App
+
+    tmp = tmp_path_factory.mktemp("fault")
+    cfg = Settings()
+    cfg.store_root = str(tmp / "store")
+    cfg.image_root = str(tmp / "images")
+    cfg.port = 0
+    cfg.persist = False
+    cfg.serve_max_batch = 64
+    cfg.serve_restart_backoff_s = 0.01
+    cfg.serve_quarantine_crashes = 3
+    cfg.alert_window_s = 0.0
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(0)
+    n = 240
+    sex = rng.choice(["male", "female"], n)
+    age = rng.integers(1, 70, n).astype(np.float64)
+    age[rng.random(n) < 0.1] = np.nan
+    surv = (rng.random(n) < np.where(sex == "female", 0.8, 0.2)).astype(
+        np.int64)
+    ds = app.store.create("ftrain")
+    ds.append_columns({
+        "Sex": sex.astype(object), "Age": age,
+        "Pclass": rng.integers(1, 4, n).astype(np.int64),
+        "Fare": rng.lognormal(2.5, 1.0, n), "Survived": surv})
+    app.store.finish("ftrain")
+    app.builder.build("ftrain", "ftrain", "fm", ["lr", "nb"], "Survived")
+    server = app.serve(background=True)
+    ctx = Context(f"http://127.0.0.1:{server.port}", poll_seconds=0.1,
+                  timeout=60)
+    # Warm both AOT ladders so tests measure serving, not compiles.
+    for name in ("fm_lr", "fm_nb"):
+        app.predictor.predict(name, [ROW])
+    yield ctx, app, server
+    server.stop()
+
+
+class _Gate:
+    """Wedge one model's device entry: the dispatcher blocks inside
+    ``entry.predict`` until released — in-flight work to drain, a busy
+    device for queue-expiry tests."""
+
+    def __init__(self, app, name):
+        self.entry = app.predictor.aot.entry(name)
+        self.orig = self.entry.predict
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def __enter__(self):
+        def wedged(X, _orig=self.orig):
+            self.started.set()
+            assert self.release.wait(30), "gate never released"
+            return _orig(X)
+
+        self.entry.predict = wedged
+        return self
+
+    def __exit__(self, *exc):
+        self.release.set()
+        self.entry.predict = self.orig
+
+
+def _model_stats(app, name):
+    return app.predictor.snapshot()["models"][name]
+
+
+def _span_names(tree):
+    out = []
+
+    def walk(node):
+        out.append(node.get("name"))
+        for c in node.get("children") or []:
+            walk(c)
+
+    for root in tree.get("spans") or tree.get("roots") or []:
+        walk(root)
+    return out
+
+
+# -- pillar 1: end-to-end deadlines -------------------------------------------
+
+def test_deadline_expires_in_queue_504_never_dispatched(fault):
+    """Acceptance: budget expires while queued behind a busy device →
+    terminal 504 (not 503), rows never dispatched (dispatch counters),
+    expiry recorded on the trace."""
+    ctx, app, server = fault
+    url = ctx.url("/trained-models/fm_lr/predict")
+    holder = {}
+    with _Gate(app, "fm_lr") as g:
+        t1 = threading.Thread(
+            target=lambda: holder.update(r1=requests.post(
+                url, json={"rows": [ROW]}, timeout=30)))
+        t1.start()
+        assert g.started.wait(10), "dispatcher never took r1"
+        before = _model_stats(app, "fm_lr")
+        t0 = time.monotonic()
+        r2 = requests.post(url, json={"rows": [ROW, ROW]},
+                           headers={"X-Deadline-Ms": "300"}, timeout=30)
+        elapsed = time.monotonic() - t0
+        # Answered at ~the budget, while the dispatcher is still wedged
+        # — the 504 never waited out serve_timeout_s.
+        assert r2.status_code == 504, r2.text
+        assert elapsed < 5.0
+        body = r2.json()["result"]
+        assert "deadline exceeded" in body and "fm_lr" in body
+        rid = r2.headers["X-Request-Id"]
+    t1.join(30)
+    assert holder["r1"].status_code == 200     # accepted work completed
+    after = _model_stats(app, "fm_lr")
+    # Only r1's single row ever reached the device; the expired pair
+    # was withdrawn/discarded before any dispatch.
+    assert after["batched_rows"] == before["batched_rows"] + 1
+    assert after["deadline_exceeded"] == before["deadline_exceeded"] + 1
+    tree = requests.get(ctx.url(f"/trace/{rid}")).json()
+    assert "deadline.expired" in _span_names(tree)
+
+
+def test_slow_dispatch_failpoint_past_deadline(fault, monkeypatch):
+    """Chaos variant of the same invariant through the new failpoint
+    seam: a slow-mode stall at pre_dispatch holds the device, the
+    deadline'd request behind it 504s within its budget and is never
+    dispatched."""
+    ctx, app, server = fault
+    monkeypatch.setattr(failpoints, "SLOW_S", 1.0)
+    url = ctx.url("/trained-models/fm_lr/predict")
+    failpoints.configure("serving.batcher.pre_dispatch=slow")
+    holder = {}
+    t1 = threading.Thread(
+        target=lambda: holder.update(r1=requests.post(
+            url, json={"rows": [ROW]}, timeout=30)))
+    t1.start()
+    time.sleep(0.2)                     # r1 taken; dispatcher stalling
+    before = _model_stats(app, "fm_lr")
+    t0 = time.monotonic()
+    r2 = requests.post(url, json={"rows": [ROW]},
+                       headers={"X-Deadline-Ms": "250"}, timeout=30)
+    elapsed = time.monotonic() - t0
+    assert r2.status_code == 504
+    assert elapsed < 0.9                # within budget, not the stall
+    t1.join(30)
+    assert holder["r1"].status_code == 200
+    time.sleep(0.2)                     # let the loop drain the queue
+    after = _model_stats(app, "fm_lr")
+    assert after["batched_rows"] == before["batched_rows"] + 1
+
+
+def test_malformed_and_spent_deadline_header(fault):
+    ctx, app, server = fault
+    url = ctx.url("/trained-models/fm_lr/predict")
+    r = requests.post(url, json={"rows": [ROW]},
+                      headers={"X-Deadline-Ms": "soon"}, timeout=10)
+    assert r.status_code == 406 and "X-Deadline-Ms" in r.json()["result"]
+    r = requests.post(url, json={"rows": [ROW]},
+                      headers={"X-Deadline-Ms": "-5"}, timeout=10)
+    assert r.status_code == 504
+
+
+def test_deadline_admission_and_retry_after_scale():
+    """Unit: admission control rejects when predicted queue wait (depth
+    × service rate) exceeds the remaining budget without consuming a
+    queue slot, and the computed QueueFull Retry-After MOVES with queue
+    depth (satellite regression for the hard-coded '1')."""
+
+    class _Wedge:
+        def __init__(self):
+            self.started = threading.Event()
+            self.release = threading.Event()
+
+        def predict(self, X):
+            self.started.set()
+            assert self.release.wait(30)
+            return np.tile(np.array([[0.5, 0.5]]), (len(X), 1))
+
+    cfg = Settings()
+    cfg.serve_queue_depth = 10
+    cfg.serve_timeout_s = 20.0
+    cfg.serve_max_wait_ms = 0.0
+    w = _Wedge()
+    stats = _Stats()
+    b = ModelBatcher("m", cfg, stats)
+    threads = []
+
+    def bg_submit(rows):
+        t = threading.Thread(
+            target=lambda: b.submit(np.zeros((rows, 2), np.float32), w))
+        t.start()
+        threads.append(t)
+
+    try:
+        bg_submit(1)                        # taken by the dispatcher
+        assert w.started.wait(10)
+        bg_submit(4)                        # queued: 4 rows
+        deadline = time.monotonic() + 10
+        while b.queue_rows() < 4:
+            assert time.monotonic() < deadline, "rows never queued"
+            time.sleep(0.01)
+        with batcher_mod._stats_lock:
+            stats.service_s_per_row = 2.0   # 2 s/row measured rate
+        # Admission: predicted wait 4×2 = 8 s >> remaining 0.5 s.
+        with pytest.raises(DeadlineExceeded) as ei:
+            b.submit(np.zeros((2, 2), np.float32), w,
+                     deadline=time.monotonic() + 0.5, budget_ms=500.0)
+        assert "admission" in str(ei.value)
+        assert b.queue_rows() == 4          # no slot consumed
+        with batcher_mod._stats_lock:
+            assert stats.deadline_exceeded == 1
+        # Retry-After scales with depth: 4 queued rows → ~8 s hint…
+        with pytest.raises(QueueFull) as q1:
+            b.submit(np.zeros((7, 2), np.float32), w)   # 4+7 > 10
+        bg_submit(4)                        # queue now 8 rows
+        deadline = time.monotonic() + 10
+        while b.queue_rows() < 8:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # …8 queued rows → ~16 s hint: the value MOVES with depth.
+        with pytest.raises(QueueFull) as q2:
+            b.submit(np.zeros((3, 2), np.float32), w)   # 8+3 > 10
+        ra1, ra2 = q1.value.retry_after_s, q2.value.retry_after_s
+        assert 1.0 <= ra1 < ra2 <= 60.0
+        assert ra1 == pytest.approx(8.0) and ra2 == pytest.approx(16.0)
+    finally:
+        w.release.set()
+        for t in threads:
+            t.join(30)
+        b.stop()
+
+
+# -- client: per-call deadline_ms ---------------------------------------------
+
+def test_client_deadline_typed_504_no_retry(fault):
+    """predict_online(deadline_ms=...) threads the budget into the
+    header; the server's terminal 504 surfaces as DeadlineExpired
+    IMMEDIATELY — elapsed ≈ the budget, never budget × retries."""
+    ctx, app, server = fault
+    with _Gate(app, "fm_lr") as g:
+        holder = {}
+        t1 = threading.Thread(
+            target=lambda: holder.update(r1=requests.post(
+                ctx.url("/trained-models/fm_lr/predict"),
+                json={"rows": [ROW]}, timeout=30)))
+        t1.start()
+        assert g.started.wait(10)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExpired):
+            Model(ctx).predict_online("fm_lr", [ROW], deadline_ms=400)
+        assert time.monotonic() - t0 < 3.0
+    t1.join(30)
+    assert holder["r1"].status_code == 200
+
+
+def test_client_spent_budget_never_sends(fault):
+    """A budget already spent client-side raises without ANY HTTP call
+    — even the model name is never resolved."""
+    ctx, app, server = fault
+    before = app.predictor.snapshot()["requests"]
+    with pytest.raises(DeadlineExpired):
+        Model(ctx).predict_online("no_such_model", [ROW],
+                                  deadline_ms=0.0001)
+    assert app.predictor.snapshot()["requests"] == before
+
+
+def test_context_deadline_bounds_retries(monkeypatch):
+    """Unit: the retry loop's sleeps and per-attempt timeouts are
+    clamped to the remaining budget — a server Retry-After longer than
+    the budget ends the loop instead of outliving the deadline."""
+
+    calls = []
+
+    class _Resp:
+        status_code = 503
+        headers = {"Retry-After": "10"}
+
+    def fake_request(self, method, url, timeout=None, **kw):
+        calls.append({"headers": kw.get("headers") or {},
+                      "timeout": timeout})
+        return _Resp()
+
+    monkeypatch.setattr(requests.Session, "request", fake_request)
+    ctx = Context("http://test.invalid", retries=5, backoff_seconds=0.01)
+    t0 = time.monotonic()
+    resp = ctx.post("/p", json={}, deadline_ms=300)
+    assert time.monotonic() - t0 < 1.0   # never slept the 10 s hint
+    assert resp.status_code == 503
+    assert calls, "no attempt made"
+    for c in calls:
+        assert float(c["headers"]["X-Deadline-Ms"]) <= 300
+        # Per-attempt socket timeout = remaining budget + the fixed
+        # 0.5 s slack that lets the server's at-deadline 504 arrive.
+        assert c["timeout"] <= 0.3 + 0.5 + 1e-6
+
+
+# -- pillar 3: dispatcher self-healing + quarantine ---------------------------
+
+def test_pre_dispatch_crash_self_heals(fault):
+    """Acceptance: pre_dispatch=raise crashes the dispatcher thread; the
+    supervised restart re-queues the batch (device never saw it) and a
+    stock client completes WITHOUT a process restart or even a retry."""
+    ctx, app, server = fault
+    before = _model_stats(app, "fm_nb")["dispatcher_restarts"]
+    failpoints.configure("serving.batcher.pre_dispatch=raise")
+    out = Model(ctx).predict_online("fm_nb", [ROW])
+    assert len(out["predictions"]) == 1
+    snap = _model_stats(app, "fm_nb")
+    assert snap["dispatcher_restarts"] == before + 1
+    assert snap["quarantined"] == 0
+
+
+def test_mid_dispatch_crash_fails_503_then_recovers(fault):
+    """A crash AFTER device dispatch cannot re-queue (double-spend):
+    the request fails 503 + Retry-After, and the restarted dispatcher
+    serves the retry."""
+    ctx, app, server = fault
+    url = ctx.url("/trained-models/fm_nb/predict")
+    failpoints.configure("serving.batcher.mid_dispatch=raise")
+    r = requests.post(url, json={"rows": [ROW]}, timeout=30)
+    assert r.status_code == 503
+    assert "crashed mid-batch" in r.json()["result"]
+    assert r.headers.get("Retry-After")
+    r = requests.post(url, json={"rows": [ROW]}, timeout=30)
+    assert r.status_code == 200
+
+
+def test_repeated_crashes_quarantine_with_alert(fault):
+    """Acceptance: crashes past serve_quarantine_crashes produce the
+    terminal quarantine 503 naming it, /healthz lists the model, the
+    serving_quarantined alert fires — and invalidate (DELETE/re-save)
+    lifts it and resolves the alert."""
+    ctx, app, server = fault
+    url = ctx.url("/trained-models/fm_nb/predict")
+    failpoints.configure("serving.batcher.pre_dispatch=raise:0")
+    r = requests.post(url, json={"rows": [ROW]}, timeout=30)
+    assert r.status_code == 503
+    assert "quarantined" in r.json()["result"]
+    assert r.headers.get("Retry-After")
+    failpoints.reset()
+    # Still quarantined — terminal until lifted, no crash loop feeding.
+    r = requests.post(url, json={"rows": [ROW]}, timeout=30)
+    assert r.status_code == 503 and "quarantined" in r.json()["result"]
+    snap = _model_stats(app, "fm_nb")
+    assert snap["quarantined"] == 1
+    assert snap["dispatcher_restarts"] >= 3
+    h = requests.get(ctx.url("/healthz")).json()
+    assert "fm_nb" in h["checks"]["dispatchers"]["quarantined"]
+    requests.get(ctx.url("/metrics"))       # an evaluation window
+    alerts = requests.get(ctx.url("/alerts")).json()
+    assert "serving_quarantined" in alerts["firing"]
+    assert "lo_serving_quarantined" in requests.get(
+        ctx.url("/metrics"), params={"format": "prometheus"}).text
+    # Lift: the DELETE/re-save path tears down the quarantined batcher.
+    # invalidate() ALONE must clear the quarantined level — a DELETEd
+    # model never creates another batcher, so deferring the reset to
+    # batcher re-creation would pin the gauge (and the alert) at 1
+    # forever (review finding).
+    app.predictor.invalidate("fm_nb")
+    assert _model_stats(app, "fm_nb")["quarantined"] == 0
+    r = requests.post(url, json={"rows": [ROW]}, timeout=30)
+    assert r.status_code == 200
+    for _ in range(2):                      # clear_windows clean reads
+        requests.get(ctx.url("/metrics"))
+    alerts = requests.get(ctx.url("/alerts")).json()
+    assert "serving_quarantined" not in alerts["firing"]
+
+
+# -- chaos smokes for the remaining new failpoint sites (tier-1) --------------
+
+def test_pre_compile_failpoint_smoke(fault):
+    ctx, app, server = fault
+    url = ctx.url("/trained-models/fm_lr/predict")
+    app.predictor.aot.invalidate("fm_lr")   # force a cold load
+    failpoints.configure("serving.aot.pre_compile=raise")
+    r = requests.post(url, json={"rows": [ROW]}, timeout=30)
+    assert r.status_code == 500
+    assert "failpoint" in r.json()["result"]
+    r = requests.post(url, json={"rows": [ROW]}, timeout=60)
+    assert r.status_code == 200             # one-shot spent: recompiles
+
+
+def test_pre_response_failpoint_smoke(fault):
+    ctx, app, server = fault
+    failpoints.configure("serving.http.pre_response=raise")
+    r = requests.get(ctx.url("/metrics"), timeout=10)
+    # The first write raised; the error path's own response write finds
+    # the one-shot spent and delivers a well-formed 500.
+    assert r.status_code == 500
+    assert requests.get(ctx.url("/metrics"), timeout=10).status_code == 200
+
+
+# -- pillar 2: graceful drain -------------------------------------------------
+
+def test_drain_gate_completes_accepted_work(fault):
+    """In-process drain semantics: the gate 503s new work with
+    Retry-After + Connection: close, reads and /healthz keep serving
+    (reporting ``draining``), and the accepted in-flight request
+    completes — zero loss — after which the tier is quiesced."""
+    ctx, app, server = fault
+    url = ctx.url("/trained-models/fm_lr/predict")
+    holder = {}
+    with _Gate(app, "fm_lr") as g:
+        t1 = threading.Thread(
+            target=lambda: holder.update(r1=requests.post(
+                url, json={"rows": [ROW]}, timeout=30)))
+        t1.start()
+        assert g.started.wait(10)
+        # The accepted request is mid-flight (handler + dispatcher):
+        # the tier must NOT read as quiesced — drain would otherwise
+        # stop the dispatchers out from under it.
+        assert not app.predictor.quiesced()
+        app.begin_drain()
+        try:
+            r = requests.post(url, json={"rows": [ROW]}, timeout=10)
+            assert r.status_code == 503
+            assert r.headers.get("Retry-After")
+            assert r.headers.get("Connection", "").lower() == "close"
+            h = requests.get(ctx.url("/healthz"), timeout=10)
+            assert h.status_code == 503
+            assert h.json()["state"] == "draining"
+            assert h.json()["checks"]["lifecycle"]["state"] == "draining"
+            assert "draining" in requests.get(ctx.url("/status"),
+                                              timeout=10).text
+            assert requests.get(ctx.url("/metrics"),
+                                timeout=10).json()["state"] == "draining"
+        finally:
+            g.release.set()
+        t1.join(30)
+        assert holder["r1"].status_code == 200  # zero accepted drops
+        deadline = time.monotonic() + 10
+        while not (app.predictor.quiesced()
+                   and app.jobs.running_count() == 0):
+            assert time.monotonic() < deadline, "never quiesced"
+            time.sleep(0.02)
+    app._draining.clear()                   # restore for later tests
+    assert requests.post(url, json={"rows": [ROW]},
+                         timeout=30).status_code == 200
+
+
+@pytest.mark.slow
+def test_chaos_drain_sigterm_zero_loss():
+    """Acceptance chaos (slow lane): SIGTERM a REAL child server while a
+    closed-loop storm is in flight — through the production signal path
+    (serving.__main__.install_graceful_shutdown). Every accepted (200)
+    request is well-formed, nothing times out or 500s, /healthz reports
+    ``draining`` during the window, and the process exits within
+    LO_TPU_DRAIN_TIMEOUT_S."""
+    import tempfile
+
+    drain_timeout = 20.0
+    with tempfile.TemporaryDirectory() as root:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["LO_TPU_DRAIN_TIMEOUT_S"] = str(drain_timeout)
+        # Hold the 3rd dispatch for SLOW_S so the drain window is
+        # observably non-empty when SIGTERM lands mid-storm.
+        env["LO_TPU_FAILPOINTS"] = "serving.batcher.pre_dispatch=slow:3"
+        proc = subprocess.Popen(
+            [sys.executable, CHILD, root], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        try:
+            port = json.loads(proc.stdout.readline())["port"]
+            base = f"http://127.0.0.1:{port}"
+            url = f"{base}/trained-models/dm_nb/predict"
+            outcomes = {"ok": 0, "rejected": 0, "dropped": 0}
+            olock = threading.Lock()
+            stop = threading.Event()
+
+            def storm():
+                while not stop.is_set():
+                    try:
+                        r = requests.post(url, json={"rows": [[0.5, -0.2]]},
+                                          timeout=30)
+                        if r.status_code == 200:
+                            ok = len(r.json()["predictions"]) == 1
+                            key = "ok" if ok else "dropped"
+                        elif r.status_code == 503:
+                            key = "rejected"
+                            if "close" in (r.headers.get("Connection")
+                                           or "").lower():
+                                stop.set()   # draining: stand down
+                        else:
+                            key = "dropped"
+                    except requests.ConnectionError:
+                        # Connect refused after exit: never accepted.
+                        key = "rejected"
+                        stop.set()
+                    except requests.RequestException:
+                        key = "dropped"
+                    with olock:
+                        outcomes[key] += 1
+
+            threads = [threading.Thread(target=storm) for _ in range(6)]
+            for t in threads:
+                t.start()
+            time.sleep(1.0)                  # storm running, stall active
+            t_term = time.monotonic()
+            proc.send_signal(signal.SIGTERM)
+            saw_draining = False
+            while proc.poll() is None:
+                try:
+                    h = requests.get(f"{base}/healthz", timeout=2)
+                    if h.json().get("state") == "draining":
+                        saw_draining = True
+                except requests.RequestException:
+                    break
+                time.sleep(0.05)
+            proc.wait(timeout=drain_timeout + 15)
+            exit_s = time.monotonic() - t_term
+            stop.set()
+            for t in threads:
+                t.join(30)
+            report = json.loads(proc.stdout.readline())
+            assert proc.returncode == 0
+            assert exit_s < drain_timeout + 10, exit_s
+            assert saw_draining, "/healthz never reported draining"
+            assert outcomes["ok"] > 0, outcomes
+            assert outcomes["dropped"] == 0, outcomes
+            assert report["quiesced"] is True
+            assert report["running_jobs"] == 0
+            assert report["serving"]["errors"] == 0
+            assert report["serving"]["timeouts"] == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+            proc.stdout.close()
+
+
+# -- satellite: alert + exposition plumbing -----------------------------------
+
+def test_deadline_alert_rule_and_prometheus_series(fault):
+    """The serving_deadline_exceeded_rate rule rides the same snapshot,
+    and the new per-model series render through the exposition grammar
+    (the PR 9 grammar test's invariants, extended)."""
+    from learningorchestra_tpu.utils import alerts as alerts_mod
+
+    ctx, app, server = fault
+    # Unit-drive the rule: two windows, second with a 100% miss rate.
+    rule = next(r for r in alerts_mod.default_rules(app.cfg)
+                if r.name == "serving_deadline_exceeded_rate")
+    state = {}
+    assert rule.sample({"serving": {"deadline_exceeded": 0,
+                                    "requests": 10}}, state) is None
+    val = rule.sample({"serving": {"deadline_exceeded": 5,
+                                   "requests": 10}}, state)
+    assert val == pytest.approx(1.0)
+    assert rule.bad(val)
+    # LO_TPU_SLO_DEADLINE_RATE=0 drops the rule.
+    cfg0 = Settings()
+    cfg0.slo_deadline_rate = 0.0
+    assert not any(r.name == "serving_deadline_exceeded_rate"
+                   for r in alerts_mod.default_rules(cfg0))
+
+    # Exposition: grammar-valid lines carrying the new series (the
+    # deadline tests above populated the counters).
+    prom_line = re.compile(
+        r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+        r"|[a-zA-Z_:][a-zA-Z0-9_:]*"
+        r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+        r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+        r" (?:[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|\+Inf|NaN))$")
+    text = requests.get(ctx.url("/metrics"),
+                        params={"format": "prometheus"}).text
+    for line in text.splitlines():
+        assert prom_line.match(line), f"bad exposition line: {line!r}"
+    for needle in ("lo_serving_deadline_exceeded_total",
+                   "lo_serving_dispatcher_restarts_total",
+                   "lo_serving_quarantined"):
+        assert re.search(rf'^{needle}\{{model="fm_lr"\}}', text, re.M), \
+            f"missing exposition series: {needle}"
+    doc = requests.get(ctx.url("/metrics")).json()
+    assert doc["serving"]["deadline_exceeded"] >= 1
+    # Every rule — including the two new ones — exposes lo_alert_firing.
+    exposed = set(re.findall(r'^lo_alert_firing\{alert="([^"]+)"\}',
+                             text, re.M))
+    assert {"serving_deadline_exceeded_rate",
+            "serving_quarantined"} <= exposed
+    assert exposed == set(doc["alerts"]["rules"])

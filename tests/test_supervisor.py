@@ -159,6 +159,44 @@ def test_supervisor_budget_exhaustion_serves_reason():
         sup.close()
 
 
+def test_supervisor_planned_restart_no_budget_new_epoch():
+    """A planned rolling restart (SIGHUP → request_planned_restart,
+    PR 11): children are terminated with the drain grace, respawned
+    under the NEXT mesh epoch, and no restart budget is consumed."""
+    import threading
+
+    cfg = Settings()
+    cfg.restart_budget = 3
+    cfg.restart_backoff_s = 0.05
+    cfg.drain_timeout_s = 1.0           # keep the TERM grace short
+    sup = _fast(Supervisor(
+        [[sys.executable, "-c", "import time; time.sleep(60)"]], cfg=cfg))
+    # thread-lifecycle is a package rule; test thread joined below.
+    t = threading.Thread(target=sup.run, name="sup-run", daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 10
+        while not sup._procs and time.time() < deadline:
+            time.sleep(0.02)
+        pid0 = sup._procs[0].pid
+        sup.request_planned_restart()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if sup.epoch == 1 and sup._procs and \
+                    sup._procs[0].pid != pid0 and \
+                    sup._procs[0].poll() is None:
+                break
+            time.sleep(0.05)
+        assert sup.epoch == 1, "planned restart never advanced the epoch"
+        assert sup._procs[0].pid != pid0
+        assert sup.restarts == 0        # no budget consumed
+        assert sup.failure is None
+    finally:
+        sup.close()
+        t.join(timeout=15)
+        assert not t.is_alive()
+
+
 def test_supervisor_health_poll_triggers_restart():
     from learningorchestra_tpu.serving.http import Router, Server
 

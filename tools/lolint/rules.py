@@ -498,17 +498,26 @@ class LogDisciplineRule(Rule):
 
 class FailpointCoverageRule(Rule):
     name = "failpoint-coverage"
-    description = ("catalog/ functions performing rename/fsync two-phase "
-                   "commits carry a registered failpoints.fire site; "
-                   "fire() sites use declared constants")
+    description = ("catalog/ rename/fsync two-phase commits AND serving/ "
+                   "device-dispatch / response-write sites carry a "
+                   "registered failpoints.fire site; fire() sites use "
+                   "declared constants")
 
-    SCOPE = (f"{PACKAGE}/catalog/",)
+    SCOPE = (f"{PACKAGE}/catalog/", f"{PACKAGE}/serving/")
     _COMMIT_CALLS = ("os.rename", "os.replace", "os.fsync")
+    #: serving/ trigger suffixes: the device dispatch the batcher's
+    #: coalescing loop makes (``entry.predict(...)`` — an AOT entry
+    #: bound locally, so the dotted name is stable) and the HTTP
+    #: response-write boundary (``self.wfile.write``). Both are the
+    #: exact seams the serving chaos tests (wedged dispatcher, deadline
+    #: expiry, committed-but-unsent response) must be able to reach.
+    _SERVING_TRIGGER_SUFFIXES = ("entry.predict", "wfile.write")
 
     def applies(self, relpath: str) -> bool:
         return _in(relpath, *self.SCOPE)
 
     def check(self, pf: ParsedFile) -> Iterator[Finding]:
+        serving = pf.path.startswith(f"{PACKAGE}/serving/")
         declared = self.declared_sites(pf)
         seen: Set[int] = set()
         for fn in pf.functions():
@@ -529,7 +538,13 @@ class FailpointCoverageRule(Rule):
                 if not isinstance(node, ast.Call):
                     continue
                 cname = call_name(node)
-                if cname in self._COMMIT_CALLS:
+                if cname in self._COMMIT_CALLS or (
+                        serving and any(
+                            cname == s or cname.endswith("." + s)
+                            for s in self._SERVING_TRIGGER_SUFFIXES)):
+                    # Attribute-boundary match: `entry.predict` /
+                    # `x.entry.predict` trigger, `reentry.predict`
+                    # does not.
                     commits.append(node)
                 elif cname.rsplit(".", 1)[-1] == "fire" and \
                         "failpoint" in cname:
@@ -537,12 +552,18 @@ class FailpointCoverageRule(Rule):
             if commits and not fires:
                 first = commits[0]
                 sym = pf.symbol_of(fn)
+                what = ("device-dispatch/response-write site" if serving
+                        else "commit point")
+                proof = ("the serving chaos tests (tests/"
+                         "test_serving_fault.py) cannot wedge/crash this "
+                         "seam" if serving else
+                         "the crash sweep (tests/test_failpoints.py) "
+                         "cannot prove recovery at this I/O boundary")
                 yield Finding(
                     self.name, pf.path, first.lineno, first.col_offset,
-                    f"{call_name(first)}() commit point without a "
-                    "failpoints.fire() site in the same function: the "
-                    "crash sweep (tests/test_failpoints.py) cannot prove "
-                    "recovery at this I/O boundary", sym)
+                    f"{call_name(first)}() {what} without a "
+                    f"failpoints.fire() site in the same function: "
+                    f"{proof}", sym)
             for fire in fires:
                 if not fire.args:
                     continue
