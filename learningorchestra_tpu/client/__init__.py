@@ -481,14 +481,17 @@ class Observability(_ServiceClient):
     def alerts(self) -> Dict:
         """The SLO alert engine's state: firing rule names plus every
         rule's value/threshold/streaks (docs/observability.md has the
-        rule table)."""
+        rule table), and ``flightrec_latest`` — the freshest flight-
+        recorder bundle id, when one exists."""
         return ResponseTreat.treatment(self.context.get("/alerts"))
 
     def healthz(self) -> Dict:
         """The deep health rollup. Returns the check document on 200;
         raises on 503 with the FIRING ALERT NAMES in the message — a
         degraded service names its reasons instead of a bare status
-        code. The probe never retries the 503 (the 503 is the answer)."""
+        code — plus the freshest flight-recorder bundle id, so the
+        error itself points at the frozen evidence. The probe never
+        retries the 503 (the 503 is the answer)."""
         resp = self.context.get("/healthz", retry_503=False)
         try:
             doc = resp.json()
@@ -500,12 +503,45 @@ class Observability(_ServiceClient):
             failed = sorted(k for k, c in checks.items()
                             if isinstance(c, dict) and not c.get("ok"))
             rid = resp.headers.get("X-Request-Id")
+            bundle = doc.get("flightrec_latest")
             raise RuntimeError(
                 "healthz degraded: failing checks "
                 f"{failed or ['unknown']}; firing alerts "
                 f"{firing or ['none']}"
+                + (f" [flight recording {bundle}]" if bundle else "")
                 + (f" [request-id {rid}]" if rid else ""))
         return ResponseTreat.treatment(resp)
+
+    # -- telemetry history & flight recorder ---------------------------------
+
+    def history(self, series: Optional[Sequence[str]] = None,
+                window_s: Optional[float] = None) -> Dict:
+        """Retained metric time-series (``GET /metrics/history``):
+        per-series ``[t, value]`` points merged from the server's
+        in-memory ring and on-disk segments — including windows from
+        BEFORE its last restart. ``series`` filters by exact name or
+        dotted prefix (``serving`` matches every ``serving.*``)."""
+        params: Dict[str, Any] = {}
+        if series:
+            params["series"] = ",".join(series)
+        if window_s is not None:
+            params["window"] = window_s
+        return ResponseTreat.treatment(
+            self.context.get("/metrics/history", params=params))
+
+    def flight_recordings(self) -> List[Dict]:
+        """Flight-recorder bundle summaries, newest first
+        (``GET /debug/flightrec``) — each names its reason, wall time
+        and on-disk files under ``<store_root>/_flightrec/``."""
+        return ResponseTreat.treatment(
+            self.context.get("/debug/flightrec"))
+
+    def record_flight(self, reason: str = "manual") -> Dict:
+        """Force a flight-recorder bundle right now
+        (``POST /debug/flightrec``) — the operator's "freeze the
+        evidence" button; returns the bundle id and directory."""
+        return ResponseTreat.treatment(self.context.post(
+            "/debug/flightrec", json={"reason": reason}))
 
 
 class Model(_ServiceClient):

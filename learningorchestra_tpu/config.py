@@ -339,6 +339,58 @@ class Settings:
         default_factory=lambda: _env("LO_TPU_LOG_LEVEL", "INFO")
     )
 
+    # --- telemetry history (utils/timeseries.py) ----------------------------
+    #: Cadence (seconds) of the background telemetry sampler: the server
+    #: snapshots its own ``/metrics`` document this often into the
+    #: history ring, whether or not anything scrapes it — retained
+    #: telemetry, not scrape luck, is what post-hoc debugging reads.
+    #: ``0`` disables the sampler thread and records one sample per
+    #: registry read instead (tests drive history deterministically this
+    #: way); negative disables history entirely.
+    telemetry_sample_s: float = field(
+        default_factory=lambda: _env("LO_TPU_TELEMETRY_SAMPLE_S", 5.0)
+    )
+    #: In-memory history ring capacity (samples). 720 × the 5 s default
+    #: cadence ≈ one hour of full-resolution history served from RAM.
+    telemetry_ring_samples: int = field(
+        default_factory=lambda: _env("LO_TPU_TELEMETRY_RING_SAMPLES", 720)
+    )
+    #: Samples per on-disk segment: every this many samples the ring
+    #: rotates a delta-encoded segment file to
+    #: ``<store_root>/_telemetry/`` so history survives restarts.
+    telemetry_segment_samples: int = field(
+        default_factory=lambda: _env("LO_TPU_TELEMETRY_SEGMENT_SAMPLES",
+                                     120)
+    )
+    #: Newest on-disk segments kept; older ones are unlinked at each
+    #: rotation (bounded retention — telemetry must never eat the disk
+    #: the ``disk_free_low`` alert guards).
+    telemetry_retention_segments: int = field(
+        default_factory=lambda: _env(
+            "LO_TPU_TELEMETRY_RETENTION_SEGMENTS", 48)
+    )
+
+    # --- flight recorder (utils/flightrec.py) -------------------------------
+    #: Newest flight-recorder bundles kept under
+    #: ``<store_root>/_flightrec/``; older bundles are pruned at each
+    #: dump. ``0`` disables the recorder entirely.
+    flightrec_keep: int = field(
+        default_factory=lambda: _env("LO_TPU_FLIGHTREC_KEEP", 8)
+    )
+    #: Minimum seconds between AUTOMATIC bundle dumps (alert firing,
+    #: healthz flip, quarantine, supervisor incident): a flapping
+    #: condition records its first transition, not one bundle per flap.
+    #: Manual ``POST /debug/flightrec`` ignores this.
+    flightrec_min_interval_s: float = field(
+        default_factory=lambda: _env("LO_TPU_FLIGHTREC_MIN_INTERVAL_S",
+                                     30.0)
+    )
+    #: Seconds of telemetry history captured into each bundle's
+    #: ``history.json`` — the "surrounding window" an operator replays.
+    flightrec_window_s: float = field(
+        default_factory=lambda: _env("LO_TPU_FLIGHTREC_WINDOW_S", 600.0)
+    )
+
     # --- resource & capacity plane (utils/resources.py, utils/alerts.py) ---
     #: Evaluation-window length (seconds) of the declarative alert engine:
     #: rule conditions are (re)checked at most once per window, driven by
@@ -381,6 +433,26 @@ class Settings:
     #: clients no longer want. 0 disables the rule.
     slo_deadline_rate: float = field(
         default_factory=lambda: _env("LO_TPU_SLO_DEADLINE_RATE", 0.05)
+    )
+    #: Fast burn-rate window (seconds) for the serving SLO rules when a
+    #: telemetry history store is attached (serving_p99_slo,
+    #: serving_reject_rate, serving_deadline_exceeded_rate): the rule
+    #: fires only while the condition is STILL bad over this recent
+    #: window. 0 keeps the legacy single-window evaluation.
+    slo_burn_fast_s: float = field(
+        default_factory=lambda: _env("LO_TPU_SLO_BURN_FAST_S", 300.0)
+    )
+    #: Slow burn-rate window (seconds): the error budget is judged over
+    #: this span, so a brief spike that consumed almost none of it stops
+    #: paging, and a slow burn that consumes it keeps paging. 0 keeps
+    #: the legacy single-window evaluation.
+    slo_burn_slow_s: float = field(
+        default_factory=lambda: _env("LO_TPU_SLO_BURN_SLOW_S", 3600.0)
+    )
+    #: Error budget: the fraction of an evaluation window that may be
+    #: out-of-SLO before its burn rate reads 1.0 (the firing line).
+    slo_burn_budget: float = field(
+        default_factory=lambda: _env("LO_TPU_SLO_BURN_BUDGET", 0.02)
     )
     #: Disk-headroom watermark (MiB) for the chunk store's filesystem:
     #: free bytes under it fires ``disk_free_low`` and degrades

@@ -43,7 +43,8 @@ from learningorchestra_tpu.serving.batcher import (
 from learningorchestra_tpu.serving.http import (
     FileResponse, HtmlResponse, HttpError, IdempotencyCache, Router,
     Server, TextResponse)
-from learningorchestra_tpu.utils import alerts, resources, tracing
+from learningorchestra_tpu.utils import (
+    alerts, flightrec, resources, timeseries, tracing)
 from learningorchestra_tpu.utils.structlog import get_logger
 from learningorchestra_tpu.viz.service import (
     ImageExists, ImageNotFound, ImageService, create_embedding_image)
@@ -81,11 +82,40 @@ class App:
         #: Idempotency-Key (the client SDK sends one per logical create)
         #: returns the first attempt's outcome instead of a spurious 409.
         self.idempotency = IdempotencyCache()
+        #: Telemetry history (utils/timeseries.py): the background
+        #: sampler snapshots _metrics_doc on its own clock (started in
+        #: serve(), so bare App construction spawns no threads), and
+        #: every registry read contributes a sample too, gated to the
+        #: same cadence — history accrues whether or not anything
+        #: scrapes the server, and survives restarts via the rotating
+        #: delta segments under <store_root>/_telemetry/.
+        self.history = timeseries.TelemetryHistory(
+            self.cfg, source=self._metrics_doc)
         #: The SLO alert engine (utils/alerts.py), evaluated over the
         #: same registry snapshot both /metrics formats render — reads
         #: of /metrics, /alerts, /healthz and the status page drive its
         #: evaluation windows (the Prometheus scrape-window model).
-        self.alerts = alerts.default_engine(self.cfg)
+        #: With the history store attached, the serving SLO rules run
+        #: as multi-window burn rates over it (fast 5 m + slow 1 h):
+        #: brief spikes stop paging, slow burns stop hiding.
+        self.alerts = alerts.default_engine(self.cfg,
+                                            history=self.history)
+        #: Flight recorder (utils/flightrec.py): on an alert firing, a
+        #: /healthz flip to 503, a dispatcher quarantine or a
+        #: supervisor incident, a bounded-retention evidence bundle
+        #: (spans, history window, resources, alerts, config, versions)
+        #: lands under <store_root>/_flightrec/.
+        self.flightrec = flightrec.FlightRecorder(self.cfg, gather={
+            "spans": lambda: tracing.recent_span_docs(2048),
+            "history": lambda: self.history.query(
+                window_s=self.cfg.flightrec_window_s),
+            "resources": lambda: resources.process_snapshot(self.cfg),
+            "alerts": self.alerts.snapshot,
+        })
+        flightrec.set_recorder(self.flightrec)
+        #: Last /healthz verdict — the firing edge (healthy → 503) is a
+        #: flight-recorder trigger.
+        self._was_healthy: Optional[bool] = None
         #: Graceful-drain latch (SIGTERM / App.drain): once set, new
         #: work answers 503 + Retry-After + Connection: close while
         #: in-flight predicts and queued jobs run to completion —
@@ -513,7 +543,15 @@ class App:
                 info, app.jobs.records(), app.store.metadata_docs(),
                 serving=mdoc.get("serving"),
                 alerts=mdoc.get("alerts"),
-                resources=mdoc.get("resources")))
+                resources=mdoc.get("resources"),
+                attribution=mdoc.get("latency_attribution"),
+                # Bounded window: the sparklines render ~140px — serve
+                # them from the in-memory ring, never a decode of every
+                # retained disk segment per 5 s auto-refresh.
+                history=app.history.query(series=[
+                    "serving.qps", "serving.queue_rows",
+                    "serving.requests", "resources.host.rss_bytes"],
+                    window_s=3600)))
 
         @self._route("GET", "/metrics")
         def metrics(req):
@@ -526,6 +564,20 @@ class App:
                 # serves, so the two can never disagree.
                 return 200, TextResponse(prometheus.render(doc))
             return 200, doc
+
+        @self._route("GET", "/metrics/history")
+        def metrics_history(req):
+            # The retained time-series behind the instantaneous
+            # /metrics view: ring + on-disk delta segments, so the
+            # answer covers windows no scrape happened to observe —
+            # including pre-restart ones.
+            app._metrics_doc()          # contribute a sample (gated)
+            series = req.q("series")
+            window = req.q("window", cast=float)
+            return 200, app.history.query(
+                series=[s.strip() for s in series.split(",") if s.strip()]
+                if series else None,
+                window_s=window)
 
         # ---- tracing (the request/job-scoped view /metrics can't give:
         # "where did THIS request spend its time")
@@ -564,12 +616,49 @@ class App:
             # other registry read — an operator polling this page IS the
             # alert engine's clock.
             app._metrics_doc()
-            return 200, app.alerts.snapshot()
+            doc = app.alerts.snapshot()
+            # The freshest evidence bundle rides along so anything that
+            # reports a firing alert can point at it (the client SDK
+            # quotes it in raised errors).
+            doc["flightrec_latest"] = app.flightrec.latest()
+            return 200, doc
 
         @self._route("GET", "/healthz")
         def healthz(_req):
             doc = app._health_doc()
-            return (200 if doc["healthy"] else 503), doc
+            healthy = doc["healthy"]
+            if app._was_healthy is not False and not healthy:
+                # The healthy → 503 edge is itself an incident worth
+                # freezing: by the time a human reads the page, the
+                # trace ring has moved on.
+                app.flightrec.dump(
+                    "healthz:503",
+                    detail={"checks": {
+                        k: c for k, c in doc["checks"].items()
+                        if isinstance(c, dict) and not c.get("ok")}})
+                doc["flightrec_latest"] = app.flightrec.latest()
+            app._was_healthy = healthy
+            return (200 if healthy else 503), doc
+
+        @self._route("GET", "/debug/flightrec")
+        def flightrec_list(_req):
+            return 200, app.flightrec.list()
+
+        @self._route("POST", "/debug/flightrec", replay_posts=False)
+        def flightrec_dump(req):
+            # Manual trigger: bypasses the automatic-dump rate limit
+            # (an operator asking for evidence should get it), still
+            # bounded by retention. Read-like — never idempotency-
+            # replayed.
+            reason = str(req.body.get("reason") or "manual")
+            bundle = app.flightrec.dump(f"manual:{reason}", force=True)
+            if bundle is None:
+                raise ValueError(
+                    "flight recorder disabled (LO_TPU_FLIGHTREC_KEEP=0) "
+                    "or dump failed — see server logs")
+            return 201, {"result": "flight-recorder bundle dumped",
+                         "bundle": bundle,
+                         "dir": os.path.join(app.flightrec.root, bundle)}
 
         @self._route("POST", "/debug/profile")
         def debug_profile(req):
@@ -619,13 +708,30 @@ class App:
                "read_pipeline": readpipe.snapshot(),
                "serving": self.predictor.snapshot(),
                "tracing": tracing.counters_snapshot(),
+               # The span-taxonomy aggregation: per-model queue-wait /
+               # device / design histograms, per-family fit sub-phases,
+               # per-route handling — "where did the p99 go" without
+               # grepping /traces.
+               "latency_attribution": tracing.attribution_snapshot(),
                "resources": resources.process_snapshot(self.cfg),
                "compile": resources.compile_snapshot(),
                "pod": {"error": pod_error,
                        "degraded": pod_error is not None},
                "profile_dir": self.cfg.profile_dir or None}
-        self.alerts.observe(doc)
+        # History BEFORE alert evaluation: the burn-rate rules read the
+        # store, so the sample that triggered this read must be in it.
+        self.history.observe(doc)
+        doc["telemetry"] = self.history.snapshot()
+        transitions = self.alerts.observe(doc)
         doc["alerts"] = self.alerts.snapshot()
+        for t in transitions:
+            if t["to"] == "firing":
+                # Freeze the evidence at the transition: rate-limited
+                # (flightrec_min_interval_s), so a flapping rule
+                # records its first edge, not one bundle per flap.
+                self.flightrec.dump(f"alert:{t['alert']}", detail=t,
+                                    doc=doc)
+        doc["flightrec"] = self.flightrec.snapshot()
         return doc
 
     def _health_doc(self) -> dict:
@@ -660,7 +766,11 @@ class App:
         return {"healthy": all(c["ok"] for c in checks.values()),
                 "state": "draining" if draining else "serving",
                 "checks": checks,
-                "mesh_epoch": spmd.mesh_epoch()}
+                "mesh_epoch": spmd.mesh_epoch(),
+                # The freshest evidence bundle, if any: a degraded
+                # verdict points at its black box (the client SDK
+                # quotes this id in the error it raises).
+                "flightrec_latest": self.flightrec.latest()}
 
     def _register_images(self, method: str) -> None:
         app = self
@@ -837,6 +947,13 @@ class App:
         # (queued requests fail fast instead of waiting out their
         # timeout against a dead worker).
         server.on_stop(self.predictor.stop)
+        # The telemetry sampler lives exactly as long as the server:
+        # started here (bare App construction spawns no threads — tests
+        # drive history via reads), stopped with it — and the stop
+        # flushes the partial segment so a restarted process serves the
+        # pre-shutdown window from disk.
+        self.history.start()
+        server.on_stop(self.history.stop)
         if background:
             return server.start_background()
         server.serve_forever()

@@ -74,7 +74,8 @@ import numpy as np
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.models.aot import AotCache, design_from_rows
 from learningorchestra_tpu.models.persistence import ModelRegistry
-from learningorchestra_tpu.utils import failpoints, profiling, tracing
+from learningorchestra_tpu.utils import (
+    failpoints, flightrec, profiling, tracing)
 from learningorchestra_tpu.utils.structlog import get_logger
 
 log = get_logger("serving.batcher")
@@ -678,7 +679,8 @@ class ModelBatcher:
                     tracing.record_span(
                         "dispatch.device", t_device, ctx=p.ctx,
                         parent_id=bsid,
-                        attrs={"co_batched": len(grp),
+                        attrs={"model": self.name,
+                               "co_batched": len(grp),
                                "batch_rows": off})
         except Exception as exc:  # noqa: BLE001 — scattered per req
             with _stats_lock:
@@ -731,6 +733,17 @@ class ModelBatcher:
             for p in requeue + lost + leftovers:
                 p.error = qerr
                 p.done.set()
+            # Freeze the evidence AFTER failing the waiters: the dump
+            # (span snapshot, history window, disk writes) can take
+            # real time, and blocked callers must get their prompt 503
+            # instead of burning deadline budget behind it — the trace
+            # ring and history are unaffected by the ordering.
+            # Best-effort by contract (flightrec.incident never
+            # raises).
+            flightrec.incident(
+                "serving.quarantine",
+                detail={"model": self.name, "crashes": self._crashes,
+                        "reason": self._quarantined})
             return False
         # Already-dispatched requests lost their results with the crash;
         # re-running them would double-spend device time — fail them 503

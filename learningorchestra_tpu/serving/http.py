@@ -111,7 +111,7 @@ class TextResponse:
 
 class Router:
     def __init__(self):
-        self._routes: List[Tuple[str, re.Pattern, Callable]] = []
+        self._routes: List[Tuple[str, re.Pattern, str, Callable]] = []
 
     def route(self, method: str, pattern: str):
         """Register ``pattern`` like "/files/{name}"."""
@@ -119,20 +119,28 @@ class Router:
             "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
 
         def deco(fn):
-            self._routes.append((method.upper(), regex, fn))
+            self._routes.append((method.upper(), regex, pattern, fn))
             return fn
 
         return deco
 
     def dispatch(self, req_method: str, url: str, body: Optional[Dict],
-                 headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
+                 headers: Optional[Dict[str, str]] = None,
+                 attrs: Optional[Dict[str, Any]] = None) -> Tuple[int, Any]:
+        """``attrs`` (the request's root-span attribute dict, recorded
+        by reference at span exit) receives the matched route PATTERN —
+        so per-route latency attribution aggregates
+        ``/trained-models/{name}/predict`` as ONE label instead of one
+        per model name (bounded cardinality by construction)."""
         parsed = urlparse(url)
-        for method, regex, fn in self._routes:
+        for method, regex, pattern, fn in self._routes:
             if method != req_method:
                 continue
             m = regex.match(parsed.path)
             if not m:
                 continue
+            if attrs is not None:
+                attrs["route"] = pattern
             req = Request(req_method, parsed.path, m.groupdict(),
                           parse_qs(parsed.query), body, headers)
             return fn(req)
@@ -283,12 +291,22 @@ def _make_handler(router: Router, request_timeout_s: Optional[float] = None):
             rid = (inbound if _REQUEST_ID_RE.match(inbound)
                    else tracing.new_id())
             self._request_id = rid
-            attrs = {"method": method, "route": self.path.split("?", 1)[0]}
+            # "path" is the raw URL; "route" is stamped by a MATCHED
+            # dispatch with the route PATTERN — what the span and the
+            # per-route latency attribution carry, so
+            # "/trained-models/{name}/predict" stays one label however
+            # many models exist. Unmatched requests (404s) carry no
+            # route at all: attribution collapses them into one "-"
+            # label instead of letting a URL scanner mint an entry per
+            # bogus path and exhaust the bounded table.
+            attrs = {"method": method,
+                     "path": self.path.split("?", 1)[0]}
             with tracing.trace("http.handle", trace_id=rid, attrs=attrs):
                 try:
                     body = self._read_body()
                     status, payload = router.dispatch(
-                        method, self.path, body, dict(self.headers.items()))
+                        method, self.path, body, dict(self.headers.items()),
+                        attrs=attrs)
                     attrs["status"] = status
                     if isinstance(payload, FileResponse):
                         self._send_file(payload)

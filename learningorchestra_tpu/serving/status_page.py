@@ -62,9 +62,73 @@ def _table(headers: List[str], rows: List[List[str]]) -> str:
     return f"<table><tr>{head}</tr>{body}</table>"
 
 
-def _serving_section(serving: Optional[Dict[str, Any]]) -> str:
+def _phase_breakdown(attribution: Optional[Dict[str, Any]],
+                     model: str) -> str:
+    """One cell answering "where did this model's p99 go": the per-
+    phase p99s the span-taxonomy aggregation attributes to it
+    (queue wait / device dispatch / design build)."""
+    if not attribution:
+        return ""
+    parts = []
+    for phase, short in (("queue.wait", "queue"),
+                         ("dispatch.device", "device"),
+                         ("design.build", "design")):
+        ent = (attribution.get(phase) or {}).get(model)
+        if ent and ent.get("p99_ms") is not None:
+            parts.append(f"{short} {ent['p99_ms']:g}")
+    return escape(" / ".join(parts))
+
+
+def _sparkline(points: List[List[float]], width: int = 140,
+               height: int = 28) -> str:
+    """One series as an inline SVG polyline — no JS, no assets, exactly
+    like the rest of the page. Flat series render mid-height so 'no
+    traffic' looks calm, not broken."""
+    if len(points) < 2:
+        return '<span style="color:#aaa">no history</span>'
+    ts = [p[0] for p in points]
+    vs = [p[1] for p in points]
+    t0, t1 = min(ts), max(ts)
+    v0, v1 = min(vs), max(vs)
+    tspan = (t1 - t0) or 1.0
+    vspan = (v1 - v0) or 1.0
+    coords = " ".join(
+        f"{(t - t0) / tspan * (width - 2) + 1:.1f},"
+        f"{height - 1 - (v - v0) / vspan * (height - 2):.1f}"
+        for t, v in zip(ts, vs))
+    return (f'<svg width="{width}" height="{height}" '
+            f'viewBox="0 0 {width} {height}">'
+            f'<polyline points="{coords}" fill="none" '
+            f'stroke="#1565c0" stroke-width="1.5"/></svg>')
+
+
+def _history_section(history: Optional[Dict[str, Any]]) -> str:
+    """Sparklines over the telemetry history store — the page's answer
+    to "what happened while nobody was watching" (the JSON form lives
+    at /metrics/history)."""
+    if not history or not history.get("series"):
+        return ""
+    cells = []
+    for name, points in sorted(history["series"].items()):
+        last = points[-1][1] if points else ""
+        cells.append(
+            f'<span class="kv"><b>{escape(str(name))}</b> '
+            f'{_sparkline(points)} {escape(f"{last:g}")}</span>')
+    span = ""
+    if history.get("from") and history.get("to"):
+        span = (f'<p style="color:#888;font-size:.75rem">'
+                f'{history.get("samples", 0)} samples over '
+                f'{history["to"] - history["from"]:.0f}s — full series '
+                f'at <a href="/metrics/history">/metrics/history</a></p>')
+    return f"<h2>History</h2><p>{''.join(cells)}</p>{span}"
+
+
+def _serving_section(serving: Optional[Dict[str, Any]],
+                     attribution: Optional[Dict[str, Any]] = None) -> str:
     """The online-inference panel: queue depth, p99, QPS per model — so
-    backpressure is visible at a glance without curling /metrics."""
+    backpressure is visible at a glance without curling /metrics. The
+    phase column decomposes each model's latency (queue / device /
+    design p99s from the span-taxonomy aggregation)."""
     if not serving:
         return ""
     agg = "".join(
@@ -82,13 +146,14 @@ def _serving_section(serving: Optional[Dict[str, Any]]) -> str:
             escape(str(m.get("queue_rows", 0))),
             escape("" if m.get("p50_ms") is None else str(m["p50_ms"])),
             escape("" if m.get("p99_ms") is None else str(m["p99_ms"])),
+            _phase_breakdown(attribution, str(name)),
             escape(str(m.get("rejected", 0))),
             escape(str(m.get("deadline_exceeded", 0))),
             escape(str(m.get("dispatcher_restarts", 0))),
         ])
     table = _table(["model", "state", "requests", "qps", "rows/batch",
-                    "queue", "p50 (ms)", "p99 (ms)", "rejected (503)",
-                    "expired (504)", "restarts"], rows)
+                    "queue", "p50 (ms)", "p99 (ms)", "phase p99s (ms)",
+                    "rejected (503)", "expired (504)", "restarts"], rows)
     return (f"<h2>Online predict ({len(rows)} models)</h2>"
             f"<p>{agg}</p>{table}")
 
@@ -147,7 +212,9 @@ def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
                   refresh_seconds: int = 5,
                   serving: Optional[Dict[str, Any]] = None,
                   alerts: Optional[Dict[str, Any]] = None,
-                  resources: Optional[Dict[str, Any]] = None) -> str:
+                  resources: Optional[Dict[str, Any]] = None,
+                  attribution: Optional[Dict[str, Any]] = None,
+                  history: Optional[Dict[str, Any]] = None) -> str:
     """Render the operator page. Inputs are exactly what the JSON routes
     return, so the page can never disagree with the API."""
     mesh = cluster.get("mesh") or {}
@@ -192,7 +259,8 @@ def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
 <p>{cluster_kvs}<span class="kv"><b>mesh</b> {mesh_txt}</span></p>
 {_alerts_section(alerts)}
 {_resources_section(resources)}
-{_serving_section(serving)}
+{_serving_section(serving, attribution)}
+{_history_section(history)}
 <h2>Jobs ({len(jobs)})</h2>
 {_table(["job", "kind", "target datasets", "status", "runtime (s)",
          "error"], job_rows)}
@@ -202,7 +270,9 @@ def render_status(cluster: Dict[str, Any], jobs: List[Dict[str, Any]],
 {refresh_seconds}s — JSON at <a href="/cluster">/cluster</a>,
 <a href="/jobs">/jobs</a>, <a href="/files">/files</a>,
 <a href="/metrics">/metrics</a>,
+<a href="/metrics/history">/metrics/history</a>,
 <a href="/traces">/traces</a>,
+<a href="/debug/flightrec">/debug/flightrec</a>,
 <a href="/resources">/resources</a>,
 <a href="/alerts">/alerts</a>,
 <a href="/healthz">/healthz</a>; Prometheus at

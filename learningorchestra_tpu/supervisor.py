@@ -312,6 +312,7 @@ class Supervisor:
                 self._stop.wait(self.POLL_S)
                 continue
             log.warning("pod incident at epoch %d: %s", self.epoch, incident)
+            self._record_incident(incident, codes, follow)
             # Give the watchdog time to flush pollable failure records
             # before the survivors die with it.
             if self._stop.wait(self.SETTLE_S):
@@ -350,6 +351,28 @@ class Supervisor:
             self._spawn_all()
         self._kill_all()
         return 0
+
+    def _record_incident(self, incident: str, codes: List[Optional[int]],
+                         follow: bool) -> None:
+        """Drop a manifest-only flight-recorder bundle on the shared
+        store root: the children about to be killed can no longer dump
+        their own, and the supervisor is the only witness to exit
+        codes. A coordinated epoch follow-up is not an incident worth a
+        bundle. Best-effort — recording must never delay the restart."""
+        if follow:
+            return
+        store_root = self.env.get("LO_TPU_STORE_ROOT") or \
+            self.cfg.store_root
+        from learningorchestra_tpu.utils import flightrec
+
+        flightrec.dump_minimal(
+            store_root, "supervisor:incident",
+            detail={"incident": incident,
+                    "exit_codes": codes,
+                    "mesh_epoch": self.epoch,
+                    "restarts": self.restarts,
+                    "restart_budget": self.cfg.restart_budget},
+            keep=self.cfg.flightrec_keep)
 
     # -- budget-exhausted fallback -------------------------------------------
 

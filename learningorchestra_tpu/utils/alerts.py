@@ -28,6 +28,29 @@ Rules read the snapshot, never mutate it, and keep their cross-window
 state (previous counter values, streak counts) inside the engine — a
 rule evaluated against two different App instances' snapshots never
 bleeds state between them because each App owns its engine.
+
+**Multi-window burn rates** (PR 13): with a telemetry history store
+attached (utils/timeseries.py), the serving SLO rules
+(``serving_p99_slo``, ``serving_reject_rate``,
+``serving_deadline_exceeded_rate``) stop judging one instantaneous
+snapshot and judge the HISTORY instead, over two windows at once:
+
+- the **slow window** (``LO_TPU_SLO_BURN_SLOW_S``, default 1 h) owns
+  the error budget (``LO_TPU_SLO_BURN_BUDGET``): a spike that consumed
+  almost none of it reads a burn rate < 1 and pages nobody, however
+  dramatic its instantaneous value was;
+- the **fast window** (``LO_TPU_SLO_BURN_FAST_S``, default 5 min)
+  guards recency: a burn that already stopped reads < 1 there and
+  resolves promptly instead of paging for an hour-old incident.
+
+A rule's value is ``min(burn_fast, burn_slow)`` and it fires above 1.0
+— so a sustained burn fires within the fast window (its slow-window
+budget is consumed quickly at a high burn rate) while brief spikes and
+stale incidents both stay silent. The history store, not scrape
+cadence, is the evaluation substrate: the background telemetry sampler
+keeps feeding it even when nothing scrapes ``/metrics``. Without a
+history store (or with a burn window knob at 0) the legacy
+single-window samplers above apply unchanged.
 """
 
 from __future__ import annotations
@@ -212,6 +235,10 @@ class AlertEngine:
                     "since": st.since,
                     "fired_count": st.fired_count,
                 }
+                # Burn-rate rules stash their per-window detail: the
+                # operator sees WHICH window is (not) burning.
+                if "burn" in st.state:
+                    rules[rule.name]["burn"] = dict(st.state["burn"])
             counters = dict(self._counters)
         return {
             "firing": sorted(n for n, doc in rules.items()
@@ -221,6 +248,108 @@ class AlertEngine:
             "clear_windows": self.clear_windows,
             **counters,
         }
+
+
+# -- multi-window burn-rate samplers (over the telemetry history) -------------
+
+def _expected_samples(samples, window_s: float) -> float:
+    """How many samples the window WOULD hold at the observed cadence —
+    the denominator that makes absent history count as in-SLO. A young
+    server (or one whose history only spans minutes of a 1 h window)
+    must not read its few samples as the whole window: a 1-minute blip
+    on a 2-minute-old process is still a blip, not a 50% burn."""
+    n = len(samples)
+    if n < 2:
+        return float(n)
+    span = samples[-1][0] - samples[0][0]
+    if span <= 0:
+        return float(n)
+    gap = span / (n - 1)
+    return max(float(n), float(window_s) / gap)
+
+
+def _p99_bad_fraction(history, window_s: float, slo_ms: float) -> \
+        Optional[float]:
+    """Fraction of the trailing window where ANY model with recent
+    traffic ran its p99 above the SLO — judged against the sample count
+    the FULL window would hold (missing history counts as in-SLO). None
+    without samples (no data: streaks hold, like every sampler)."""
+    samples = history.window(window_s)
+    if not samples:
+        return None
+    bad = 0
+    for _t, values in samples:
+        for name, val in values.items():
+            if not (name.startswith("serving.models.")
+                    and name.endswith(".p99_ms")):
+                continue
+            qps = values.get(name[: -len(".p99_ms")] + ".qps") or 0.0
+            if qps > 0 and val > slo_ms:
+                bad += 1
+                break
+    return bad / _expected_samples(samples, window_s)
+
+
+def _ratio_bad_fraction(history, window_s: float, bad_key: str,
+                        ok_key: str, threshold: float) -> Optional[float]:
+    """Fraction of the window's sample-to-sample intervals whose
+    ``Δbad / (Δbad + Δok)`` ratio exceeded ``threshold`` — the same
+    "how much of this window was out of SLO" unit the p99 rule
+    measures, so every burn rule divides by one budget. Counters that
+    moved backwards (process restart) clamp to 0 for that interval;
+    traffic-free intervals count as in-SLO. None without at least two
+    samples carrying both counters."""
+    samples = history.window(window_s)
+    points = [(t, v) for t, v in samples
+              if bad_key in v and ok_key in v]
+    if len(points) < 2:
+        return None
+    bad_intervals = 0
+    for (_t0, prev), (_t1, cur) in zip(points, points[1:]):
+        d_bad = max(0.0, cur[bad_key] - prev[bad_key])
+        d_ok = max(0.0, cur[ok_key] - prev[ok_key])
+        offered = d_bad + d_ok
+        if offered > 0 and (d_bad / offered) > threshold:
+            bad_intervals += 1
+    # Same missing-history-is-in-SLO denominator as the p99 rule.
+    return bad_intervals / max(len(points) - 1.0,
+                               _expected_samples(points, window_s) - 1.0)
+
+
+def burn_rate_sample(history, cfg: Settings,
+                     bad_fraction_fn: Callable) -> Callable:
+    """Build a multi-window burn-rate sampler. ``bad_fraction_fn(history,
+    window_s)`` measures the out-of-SLO fraction of one window; the
+    sample is ``min(fast, slow) / budget`` — both windows must be
+    burning for the rule to read above its 1.0 firing line. The last
+    per-window burns land in the rule's state dict, which the snapshot
+    surfaces for operators."""
+    fast_s = float(cfg.slo_burn_fast_s)
+    slow_s = float(cfg.slo_burn_slow_s)
+    budget = max(1e-9, float(cfg.slo_burn_budget))
+
+    def sample(_snapshot: Dict[str, Any],
+               state: Dict[str, Any]) -> Optional[float]:
+        fast = bad_fraction_fn(history, fast_s)
+        slow = bad_fraction_fn(history, slow_s)
+        if fast is None or slow is None:
+            return None
+        burn_fast, burn_slow = fast / budget, slow / budget
+        state["burn"] = {"fast": round(burn_fast, 4),
+                         "slow": round(burn_slow, 4),
+                         "fast_window_s": fast_s, "slow_window_s": slow_s}
+        return min(burn_fast, burn_slow)
+
+    return sample
+
+
+def _burn_windows_enabled(cfg: Settings, history) -> bool:
+    # A DISABLED history store (LO_TPU_TELEMETRY_SAMPLE_S < 0: window()
+    # forever empty) must fall back to the legacy instantaneous
+    # samplers — burn rules over it would return None every window and
+    # silently never fire any serving SLO alert.
+    return (history is not None and getattr(history, "enabled", True)
+            and cfg.slo_burn_fast_s > 0 and cfg.slo_burn_slow_s > 0)
 
 
 # -- the default rule set -----------------------------------------------------
@@ -311,31 +440,77 @@ def _disk_free(snapshot: Dict[str, Any],
     return _path(snapshot, "resources", "disk", "free_bytes")
 
 
-def default_rules(cfg: Settings) -> List[AlertRule]:
+def default_rules(cfg: Settings, history=None) -> List[AlertRule]:
     """The shipped rule table (docs/observability.md). Thresholds come
-    from Settings; a 0 threshold knob drops its rule entirely."""
+    from Settings; a 0 threshold knob drops its rule entirely. With a
+    telemetry ``history`` store attached (and burn windows enabled),
+    the three serving SLO rules evaluate as multi-window burn rates
+    over it — value ``min(burn_fast, burn_slow)``, firing line 1.0 —
+    instead of the legacy instantaneous single-window samplers."""
+    burn = _burn_windows_enabled(cfg, history)
     rules: List[AlertRule] = []
     if cfg.slo_p99_ms > 0:
-        rules.append(AlertRule(
-            name="serving_p99_slo", severity="warning",
-            summary="online predict recent-window p99 above its SLO "
-                    "for the worst model",
-            sample=_serving_worst_p99, threshold=float(cfg.slo_p99_ms)))
+        slo_ms = float(cfg.slo_p99_ms)
+        if burn:
+            rules.append(AlertRule(
+                name="serving_p99_slo", severity="warning",
+                summary="online predict p99 burning its error budget: "
+                        f"out-of-SLO (> {slo_ms:g}ms) fraction of both "
+                        "the fast and the slow history window exceeds "
+                        "the budget (brief spikes stay silent; "
+                        "sustained burns fire within the fast window)",
+                sample=burn_rate_sample(
+                    history, cfg,
+                    lambda h, w, slo=slo_ms: _p99_bad_fraction(h, w, slo)),
+                threshold=1.0, for_windows=1))
+        else:
+            rules.append(AlertRule(
+                name="serving_p99_slo", severity="warning",
+                summary="online predict recent-window p99 above its SLO "
+                        "for the worst model",
+                sample=_serving_worst_p99, threshold=slo_ms))
     if cfg.slo_reject_rate > 0:
-        rules.append(AlertRule(
-            name="serving_reject_rate", severity="warning",
-            summary="predict queue rejecting a sustained fraction of "
-                    "offered requests (capacity, not a blip)",
-            sample=_reject_rate, threshold=float(cfg.slo_reject_rate)))
+        if burn:
+            rate = float(cfg.slo_reject_rate)
+            rules.append(AlertRule(
+                name="serving_reject_rate", severity="warning",
+                summary="predict-queue rejection rate burning its error "
+                        "budget over both history windows (capacity, "
+                        "not a blip)",
+                sample=burn_rate_sample(
+                    history, cfg,
+                    lambda h, w, r=rate: _ratio_bad_fraction(
+                        h, w, "serving.rejected", "serving.requests", r)),
+                threshold=1.0, for_windows=1))
+        else:
+            rules.append(AlertRule(
+                name="serving_reject_rate", severity="warning",
+                summary="predict queue rejecting a sustained fraction of "
+                        "offered requests (capacity, not a blip)",
+                sample=_reject_rate, threshold=float(cfg.slo_reject_rate)))
     if cfg.slo_deadline_rate > 0:
-        rules.append(AlertRule(
-            name="serving_deadline_exceeded_rate", severity="warning",
-            summary="a sustained fraction of predict requests is dying "
-                    "at its deadline (admission or in-queue expiry) — "
-                    "callers abandon answers faster than the tier "
-                    "produces them",
-            sample=_deadline_rate,
-            threshold=float(cfg.slo_deadline_rate)))
+        if burn:
+            rate = float(cfg.slo_deadline_rate)
+            rules.append(AlertRule(
+                name="serving_deadline_exceeded_rate", severity="warning",
+                summary="deadline-miss rate burning its error budget "
+                        "over both history windows — callers abandon "
+                        "answers faster than the tier produces them",
+                sample=burn_rate_sample(
+                    history, cfg,
+                    lambda h, w, r=rate: _ratio_bad_fraction(
+                        h, w, "serving.deadline_exceeded",
+                        "serving.requests", r)),
+                threshold=1.0, for_windows=1))
+        else:
+            rules.append(AlertRule(
+                name="serving_deadline_exceeded_rate", severity="warning",
+                summary="a sustained fraction of predict requests is "
+                        "dying at its deadline (admission or in-queue "
+                        "expiry) — callers abandon answers faster than "
+                        "the tier produces them",
+                sample=_deadline_rate,
+                threshold=float(cfg.slo_deadline_rate)))
     rules.append(AlertRule(
         name="serving_quarantined", severity="warning",
         summary="a model's dispatcher crashed past its quarantine "
@@ -370,7 +545,8 @@ def default_rules(cfg: Settings) -> List[AlertRule]:
     return rules
 
 
-def default_engine(cfg: Settings) -> AlertEngine:
-    return AlertEngine(default_rules(cfg), window_s=cfg.alert_window_s,
+def default_engine(cfg: Settings, history=None) -> AlertEngine:
+    return AlertEngine(default_rules(cfg, history=history),
+                       window_s=cfg.alert_window_s,
                        for_windows=cfg.alert_for_windows,
                        clear_windows=cfg.alert_clear_windows)

@@ -27,7 +27,13 @@ Mapping conventions:
   misses, cumulative compile seconds, cache hits);
 - ``alerts`` → ``lo_alert_firing{alert=...}`` 0/1 gauges with
   ``lo_alert_value``/``lo_alert_threshold`` next to them, plus engine
-  counters; ``pod`` → ``lo_pod_degraded``.
+  counters; ``pod`` → ``lo_pod_degraded``;
+- ``latency_attribution`` (the span-taxonomy aggregation,
+  utils/tracing.py) → ``lo_phase_seconds{phase=...,label=...}``
+  histograms — queue wait / device dispatch / design build per model,
+  fit sub-phases per family, handling per route;
+- ``telemetry`` (utils/timeseries.py) → ``lo_telemetry_*`` gauges;
+  ``flightrec`` (utils/flightrec.py) → ``lo_flightrec_*`` counters.
 """
 
 from __future__ import annotations
@@ -217,6 +223,32 @@ def render(doc: Dict[str, Any]) -> str:
     if comp:
         _flat_counters(w, "lo_compile", comp, _COUNTER,
                        "XLA compile accounting counter")
+
+    attrib = doc.get("latency_attribution") or {}
+    if attrib:
+        w.header("lo_phase_seconds", _HISTOGRAM,
+                 "Latency attributed per phase of the span taxonomy "
+                 "(queue wait / device dispatch / design build per "
+                 "model, fit sub-phases per family, handling per route)")
+        for phase, labels in sorted(attrib.items()):
+            for label, ent in sorted(labels.items()):
+                buckets = ent.get("buckets")
+                if buckets is None:
+                    continue
+                w.histogram("lo_phase_seconds",
+                            {"phase": phase, "label": label}, buckets,
+                            ent.get("total_s", 0.0), ent.get("count", 0))
+
+    tele = doc.get("telemetry") or {}
+    if tele:
+        # Mixed live values (ring occupancy) and monotone totals:
+        # gauge is the honest common type, like lo_trace_*.
+        _flat_counters(w, "lo_telemetry", tele, _GAUGE,
+                       "Telemetry history store metric")
+    rec = doc.get("flightrec") or {}
+    if rec:
+        _flat_counters(w, "lo_flightrec", rec, _GAUGE,
+                       "Flight recorder metric")
 
     pod = doc.get("pod") or {}
     if pod:

@@ -53,7 +53,7 @@ __all__ = [
     "TraceContext", "current", "new_id", "trace", "span", "job_trace",
     "attach", "record_span", "to_wire", "from_wire", "ingest",
     "spans_for", "pop_spans", "trace_tree", "recent_traces",
-    "counters_snapshot",
+    "counters_snapshot", "attribution_snapshot", "recent_span_docs",
     "reset", "set_sample", "set_capacity", "set_process",
 ]
 
@@ -191,10 +191,105 @@ def current() -> Optional[TraceContext]:
     return _ctx.get()
 
 
+# -- latency attribution ------------------------------------------------------
+
+#: Span names aggregated into the per-model/per-phase histogram table,
+#: mapped to the attribute carrying their label. ``fit.<family>.<sub>``
+#: names are handled structurally (phase ``fit.<sub>``, label family).
+_ATTR_PHASES = {"queue.wait": "model", "dispatch.device": "model",
+                "design.build": "model", "batch.coalesce": "model",
+                "http.handle": "route"}
+#: Cardinality bound on (phase, label) entries: past it, new labels are
+#: dropped (counted) instead of letting a scanner of made-up model
+#: names grow /metrics without bound — the PR 6 _stats lesson.
+_ATTR_MAX_ENTRIES = 512
+#: (phase, label) -> {count, total_s, max_s, buckets}. The seam that
+#: turns the span taxonomy into "where did the p99 go" without grepping
+#: /traces: every recorded span whose name is in the taxonomy ALSO
+#: lands in a log-bucketed histogram keyed by phase and model/family.
+_attrib: Dict[tuple, Dict[str, Any]] = {}
+
+
+def _attrib_key(name: str,
+                attrs: Optional[Dict[str, Any]]) -> Optional[tuple]:
+    label_attr = _ATTR_PHASES.get(name)
+    if label_attr is not None:
+        label = (attrs or {}).get(label_attr)
+        if label:
+            return (name, str(label))
+        # Only http.handle collapses label-less spans into "-"
+        # (unmatched 404s carry no route by design). Model-labeled
+        # phases SKIP instead: SPMD workers' job-path dispatch.device
+        # spans carry no model, and folding multi-second sweep programs
+        # into a "serving" phase would wildly inflate its percentiles.
+        return (name, "-") if name == "http.handle" else None
+    if name.startswith("fit."):
+        parts = name.split(".")
+        if len(parts) == 3:                 # fit.<family>.<sub-phase>
+            return (f"fit.{parts[2]}", parts[1])
+        if len(parts) == 2:                 # fit.<family>
+            return ("fit", parts[1])
+    return None
+
+
+def _attrib_observe(span_obj: Span) -> None:
+    """Fold one span into the attribution table (caller holds _lock).
+    Deliberately independent of ring capacity: a server with span
+    retention off still answers the aggregate question."""
+    key = _attrib_key(span_obj.name, span_obj.attrs)
+    if key is None:
+        return
+    ent = _attrib.get(key)
+    if ent is None:
+        if len(_attrib) >= _ATTR_MAX_ENTRIES:
+            _counters["attribution_dropped"] = \
+                _counters.get("attribution_dropped", 0) + 1
+            return
+        from learningorchestra_tpu.utils import profiling
+
+        ent = _attrib[key] = {"count": 0, "total_s": 0.0, "max_s": 0.0,
+                              "buckets": profiling.new_histogram()}
+    from learningorchestra_tpu.utils import profiling
+
+    ent["count"] += 1
+    ent["total_s"] += span_obj.duration_s
+    ent["max_s"] = max(ent["max_s"], span_obj.duration_s)
+    profiling.observe(ent["buckets"], span_obj.duration_s)
+
+
+def attribution_snapshot() -> Dict[str, Dict[str, Any]]:
+    """The ``latency_attribution`` section of ``/metrics``: per-phase,
+    per-model (or per-family, per-route) latency histograms aggregated
+    from the span taxonomy — ``queue.wait`` / ``dispatch.device`` /
+    ``design.build`` / ``batch.coalesce`` by model, ``fit.*`` by
+    family, ``http.handle`` by route. Derived from SAMPLED spans, so
+    under ``LO_TPU_TRACE_SAMPLE<1`` it attributes the sampled subset."""
+    from learningorchestra_tpu.utils import profiling
+
+    with _lock:
+        items = [(k, dict(v, buckets=list(v["buckets"])))
+                 for k, v in _attrib.items()]
+    out: Dict[str, Dict[str, Any]] = {}
+    for (phase, label), ent in sorted(items):
+        p50 = profiling.quantile_from_buckets(ent["buckets"], 0.50)
+        p99 = profiling.quantile_from_buckets(ent["buckets"], 0.99)
+        out.setdefault(phase, {})[label] = {
+            "count": ent["count"],
+            "total_s": round(ent["total_s"], 6),
+            "max_s": round(ent["max_s"], 6),
+            "mean_ms": round(ent["total_s"] / ent["count"] * 1e3, 3),
+            "p50_ms": None if p50 is None else round(p50 * 1e3, 3),
+            "p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+            "buckets": ent["buckets"],
+        }
+    return out
+
+
 def _record(span_obj: Span, ingested: bool = False) -> None:
     with _lock:
         cap = _capacity()
         _counters["spans_ingested" if ingested else "spans_recorded"] += 1
+        _attrib_observe(span_obj)
         if cap <= 0:
             _counters["spans_dropped"] += 1
             return
@@ -453,7 +548,11 @@ def recent_traces(route: Optional[str] = None, kind: Optional[str] = None,
                         for s in spans if s.name.startswith("job.")} - {""})
         extent_ms = (max(s.start + s.duration_s for s in spans)
                      - min(s.start for s in spans)) * 1e3
-        if route is not None and route not in str(attrs.get("route", "")):
+        if route is not None and route not in str(attrs.get("route", "")) \
+                and route not in str(attrs.get("path", "")):
+            # "route" is the matched route PATTERN on HTTP spans (one
+            # label per route); "path" keeps the concrete URL, so both
+            # "/files/{name}" and "/files/my_dataset" filters work.
             continue
         if kind is not None and kind not in kinds \
                 and kind not in root.name:
@@ -471,6 +570,16 @@ def recent_traces(route: Optional[str] = None, kind: Optional[str] = None,
     return out
 
 
+def recent_span_docs(limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The newest ``limit`` buffered spans as docs (buffer order =
+    completion order) — what the flight recorder freezes into a
+    bundle's ``spans.json``."""
+    spans = _snapshot()
+    if limit is not None and len(spans) > limit:
+        spans = spans[-limit:]
+    return [s.to_doc() for s in spans]
+
+
 def counters_snapshot() -> Dict[str, Any]:
     """Tracing's own health counters for ``/metrics``."""
     with _lock:
@@ -481,8 +590,10 @@ def counters_snapshot() -> Dict[str, Any]:
 
 
 def reset() -> None:
-    """Drop every span and zero counters (test isolation)."""
+    """Drop every span, the attribution table, and zero counters (test
+    isolation)."""
     with _lock:
         _spans.clear()
+        _attrib.clear()
         for k in _counters:
             _counters[k] = 0

@@ -1,0 +1,25 @@
+"""metric-doc-coverage BAD fixture: emits series the (test-supplied)
+docs/observability.md does not mention — the plain literal, a resolved
+f-string expansion, and an unresolvable f-string whose literal prefix
+is also undocumented."""
+
+
+class _W:
+    def header(self, name, mtype, help_text):
+        pass
+
+    def sample(self, name, labels, value):
+        pass
+
+
+def render(doc):
+    w = _W()
+    w.header("lo_fixture_undocumented", "gauge", "not in the doc")
+    w.sample("lo_fixture_undocumented", None, 1)
+    for key in ("alpha", "beta"):
+        name = f"lo_fx_{key}_total"
+        w.header(name, "counter", f"per-key series ({key})")
+        w.sample(name, None, 0)
+    for key, val in sorted(doc.items()):
+        w.sample(f"lo_fx_dynamic_{key}", None, val)
+    return w

@@ -1,0 +1,24 @@
+"""metric-doc-coverage GOOD fixture: every emitted series (and the
+literal prefix of the dynamically-keyed one) appears in the
+test-supplied docs/observability.md."""
+
+
+class _W:
+    def header(self, name, mtype, help_text):
+        pass
+
+    def sample(self, name, labels, value):
+        pass
+
+
+def render(doc):
+    w = _W()
+    w.header("lo_fixture_documented", "gauge", "present in the doc")
+    w.sample("lo_fixture_documented", None, 1)
+    for key in ("alpha", "beta"):
+        name = f"lo_cov_{key}_total"
+        w.header(name, "counter", f"per-key series ({key})")
+        w.sample(name, None, 0)
+    for key, val in sorted(doc.items()):
+        w.sample(f"lo_cov_dynamic_{key}", None, val)
+    return w

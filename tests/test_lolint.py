@@ -41,6 +41,13 @@ CASES = {
                            "failpoint_coverage"),
 }
 
+#: Finalize-only rules (no per-file check findings): their paired
+#: fixtures are exercised by dedicated whole-project tests below, not
+#: by the generic check() parametrization.
+FINALIZE_CASES = {
+    "metric-doc-coverage": "metric_doc_coverage",
+}
+
 
 def _fixture(stem, variant):
     with open(os.path.join(FIXDIR, f"{stem}_{variant}.py"),
@@ -129,6 +136,65 @@ def test_handler_error_map_flags_unmapped_exception_class(tmp_path):
     good = _project_with(tmp_path, "learningorchestra_tpu/serving/fx.py",
                          _fixture("handler_error_map", "good"))
     assert list(rule.finalize(good)) == []
+
+
+def test_metric_doc_coverage_bad_fixture_fires(tmp_path):
+    """Undocumented series fire — the plain literal, the RESOLVED
+    f-string expansions (per-key loop), and the dynamic-key fallback
+    prefix — each anchored to a prometheus.py line."""
+    (rule,) = rules_by_name(["metric-doc-coverage"])
+    project = _project_with(
+        tmp_path, "learningorchestra_tpu/utils/prometheus.py",
+        _fixture("metric_doc_coverage", "bad"))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text("no series documented here\n")
+    finds = list(rule.finalize(project))
+    msgs = "\n".join(f.message for f in finds)
+    assert "lo_fixture_undocumented" in msgs
+    # Resolved against the nearest enclosing literal for-loop: the
+    # exact per-key names, never a cross-loop cartesian superset.
+    assert "lo_fx_alpha_total" in msgs and "lo_fx_beta_total" in msgs
+    # Unresolvable placeholder (dict keys) degrades to its literal
+    # prefix.
+    assert "lo_fx_dynamic_" in msgs
+    assert all(f.rule == "metric-doc-coverage" and f.line > 0
+               for f in finds)
+
+
+def test_metric_doc_coverage_good_fixture_clean(tmp_path):
+    (rule,) = rules_by_name(["metric-doc-coverage"])
+    project = _project_with(
+        tmp_path, "learningorchestra_tpu/utils/prometheus.py",
+        _fixture("metric_doc_coverage", "good"))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `lo_fixture_documented` | gauge |\n"
+        "| `lo_cov_alpha_total` / `lo_cov_beta_total` | counter |\n"
+        "dynamic fallbacks: `lo_cov_dynamic_*`\n")
+    assert list(rule.finalize(project)) == []
+
+
+def test_metric_doc_coverage_real_renderer_resolves_exact_names():
+    """Against the REAL renderer: the per-key loops resolve to the
+    exact per-model serving series (no cartesian mixing between
+    loops), and the series set includes the new observability-plane
+    families."""
+    from tools.lolint.rules import MetricDocCoverageRule
+
+    with open(os.path.join(
+            REPO, "learningorchestra_tpu", "utils",
+            "prometheus.py"), encoding="utf-8") as f:
+        pf = parse_source(f.read(),
+                          "learningorchestra_tpu/utils/prometheus.py")
+    names = set(MetricDocCoverageRule.series_names(pf))
+    assert "lo_serving_requests_total" in names
+    assert "lo_phase_seconds" in names
+    assert "lo_telemetry" in names and "lo_flightrec" in names
+    # Cross-loop pollution would manufacture this name — the gauge
+    # loop's keys must never pick up the counter loop's suffix.
+    assert "lo_serving_qps_total" not in names
 
 
 def test_env_discipline_doc_coverage(tmp_path):
@@ -360,8 +426,10 @@ def test_cli_list_rules_and_bad_rule_name(capsys):
 
 def test_every_rule_has_fixture_coverage():
     """Adding a rule without a paired fixture is itself a failure."""
-    assert sorted(CASES) == sorted(r.name for r in ALL_RULES)
-    for stem in (s for _, s in CASES.values()):
+    assert sorted(set(CASES) | set(FINALIZE_CASES)) == \
+        sorted(r.name for r in ALL_RULES)
+    stems = [s for _, s in CASES.values()] + list(FINALIZE_CASES.values())
+    for stem in stems:
         for variant in ("bad", "good"):
             assert os.path.isfile(
                 os.path.join(FIXDIR, f"{stem}_{variant}.py"))

@@ -1,0 +1,286 @@
+"""Telemetry history store (utils/timeseries.py): flattening, ring
+bounds, delta-segment rotation/retention, restart survival, query
+filtering, the sampler thread — and the multi-window burn-rate alert
+rules (utils/alerts.py) evaluated over it, pinned against the legacy
+single-window behavior they replace."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.utils import alerts, timeseries
+
+
+def _cfg(tmp_path, **kw):
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.telemetry_sample_s = 0.0          # record on every observe()
+    cfg.telemetry_ring_samples = 16
+    cfg.telemetry_segment_samples = 5
+    cfg.telemetry_retention_segments = 3
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _doc(i, p99=10.0, qps=1.0, rejected=0, deadline=0):
+    return {"serving": {"requests": i, "rejected": rejected,
+                        "deadline_exceeded": deadline,
+                        "models": {"m": {"p99_ms": p99, "qps": qps}}},
+            "resources": {"host": {"rss_bytes": 1000 + i}}}
+
+
+def _fill(history, n, start, step=10.0, doc_fn=_doc):
+    for i in range(n):
+        assert history.observe(doc_fn(i), now=start + i * step)
+
+
+# -- flattening ---------------------------------------------------------------
+
+def test_flatten_numeric_leaves_only():
+    flat = timeseries.flatten_doc({
+        "a": 1, "b": 2.5, "c": True, "d": "text", "e": None,
+        "nest": {"x": 3, "list": [1, 2]},
+        "alerts": {"rules": {"r": {"threshold": 1}}},
+        "ops": {"fit.lr": {"count": 9}},
+    })
+    assert flat == {"a": 1.0, "b": 2.5, "nest.x": 3.0}
+
+
+def test_delta_encoding_round_trips_and_is_sparse():
+    samples = [(100.0, {"a": 1.0, "b": 2.0}),
+               (110.0, {"a": 1.0, "b": 3.0}),
+               (120.0, {"a": 1.0, "c": 5.0})]       # b disappears
+    text = timeseries._encode_segment(samples)
+    lines = text.strip().splitlines()
+    assert "v" in json.loads(lines[0])
+    # Second record carries ONLY the changed key.
+    assert json.loads(lines[1]) == {"t": 110.0, "d": {"b": 3.0}}
+    assert json.loads(lines[2])["x"] == ["b"]
+    assert timeseries._decode_segment(text) == samples
+    # A torn tail keeps the good prefix instead of poisoning the file.
+    assert len(timeseries._decode_segment(text + '{"t": 130, "d"')) == 3
+
+
+# -- ring / segments / retention ----------------------------------------------
+
+def test_ring_bounded_and_segments_rotate(tmp_path):
+    h = timeseries.TelemetryHistory(_cfg(tmp_path))
+    _fill(h, 23, start=time.time() - 300)
+    with h._lock:
+        assert len(h._ring) == 16           # ring cap
+    segs = sorted(os.listdir(h.root))
+    assert len(segs) == 3                   # 23 // 5 = 4, retention 3
+    snap = h.snapshot()
+    assert snap["segments_written"] == 4 and snap["segments"] == 3
+    assert snap["samples"] == 23 and snap["series"] >= 4
+
+
+def test_gating_dedupes_reads(tmp_path):
+    cfg = _cfg(tmp_path, telemetry_sample_s=100.0)
+    h = timeseries.TelemetryHistory(cfg)
+    now = time.time()
+    assert h.observe(_doc(0), now=now - 200)
+    assert not h.observe(_doc(1), now=now - 199)    # gated out
+    assert h.observe(_doc(2), now=now - 99)
+    assert len(h.window(now=now)) == 2
+
+
+def test_negative_cadence_disables(tmp_path):
+    h = timeseries.TelemetryHistory(_cfg(tmp_path,
+                                         telemetry_sample_s=-1.0))
+    assert not h.observe(_doc(0))
+    assert h.window() == []
+
+
+def test_query_windows_series_filter_and_restart(tmp_path):
+    cfg = _cfg(tmp_path)
+    now = time.time()
+    h = timeseries.TelemetryHistory(cfg)
+    _fill(h, 13, start=now - 130)
+    q = h.query(series=["serving.requests"], window_s=65, now=now)
+    assert set(q["series"]) == {"serving.requests"}
+    assert len(q["series"]["serving.requests"]) == 6   # t in (now-65, now)
+    # Prefix match: "serving" catches the nested model series too.
+    q = h.query(series=["serving"], now=now)
+    assert "serving.models.m.p99_ms" in q["series"]
+    assert "resources.host.rss_bytes" not in q["series"]
+    # No duplicate timestamps from the disk/ring merge.
+    ts = [p[0] for p in q["series"]["serving.requests"]]
+    assert len(ts) == len(set(ts)) == 13
+
+    # Restart: a NEW store over the same root serves the pre-restart
+    # window from the flushed segments.
+    h.stop()                               # flush partial segment
+    h2 = timeseries.TelemetryHistory(cfg)
+    q2 = h2.query(series=["serving.requests"], now=now)
+    assert len(q2["series"]["serving.requests"]) == 13
+    assert q2["from"] is not None and q2["from"] < now - 100
+
+
+def test_sampler_survives_stop_start_cycle(tmp_path):
+    """A serve→stop→serve cycle gets a LIVE sampler again: stop()
+    latches the event, start() must clear it (review finding — the
+    restarted thread used to exit on its first wait, silently)."""
+    cfg = _cfg(tmp_path, telemetry_sample_s=0.05)
+    h = timeseries.TelemetryHistory(cfg)
+    ticked = threading.Event()
+    h._source = lambda: (h.observe(_doc(1)), ticked.set())
+    h.start()
+    assert ticked.wait(5.0)
+    h.stop()
+    ticked.clear()
+    h.start()
+    assert ticked.wait(5.0), "restarted sampler never ticked"
+    h.stop()
+
+
+def test_sampler_thread_runs_and_stops(tmp_path):
+    cfg = _cfg(tmp_path, telemetry_sample_s=0.05)
+    calls = threading.Event()
+    h = timeseries.TelemetryHistory(cfg)
+
+    def source():
+        h.observe(_doc(1))
+        calls.set()
+
+    h._source = source
+    h.start()
+    assert calls.wait(5.0)
+    h.stop()
+    assert h._thread is None
+    assert h.snapshot()["samples"] >= 1
+    # Idempotent + source errors counted, never raised.
+    h2 = timeseries.TelemetryHistory(cfg)
+    h2._source = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    h2.start()
+    h2.start()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if h2.snapshot()["sampler_errors"] >= 1:
+            break
+        time.sleep(0.01)
+    h2.stop()
+    assert h2.snapshot()["sampler_errors"] >= 1
+
+
+# -- burn-rate rules over the history -----------------------------------------
+
+def _burn_cfg(tmp_path, **kw):
+    # Ring big enough to hold the whole synthetic hour — burn windows
+    # must see the full history, not a truncated tail.
+    cfg = _cfg(tmp_path, telemetry_ring_samples=256,
+               telemetry_segment_samples=64,
+               telemetry_retention_segments=8)
+    cfg.slo_burn_fast_s = 300.0
+    cfg.slo_burn_slow_s = 3600.0
+    cfg.slo_burn_budget = 0.02            # 72 s of a 1 h window
+    cfg.slo_p99_ms = 500.0
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _p99_history(tmp_path, bad_since_s, now, step=30.0):
+    """1 h of samples every 30 s; p99 breaches the SLO for the trailing
+    ``bad_since_s`` seconds."""
+    cfg = _burn_cfg(tmp_path)
+    h = timeseries.TelemetryHistory(cfg)
+    n = int(3600 / step)
+    for i in range(n):
+        t = now - 3600 + i * step
+        bad = t > now - bad_since_s
+        h.observe(_doc(i, p99=900.0 if bad else 10.0), now=t)
+    return cfg, h
+
+
+def test_short_spike_does_not_fire_burn_rule_but_fired_legacy(tmp_path):
+    """Acceptance: a p99 spike BELOW the slow-window budget (30 s bad
+    out of 1 h, budget 72 s) does NOT fire serving_p99_slo under
+    burn-rate evaluation — while the OLD single-window rule, driven
+    with the same breach, fired. Both behaviors pinned."""
+    now = time.time()
+    cfg, h = _p99_history(tmp_path / "burn", bad_since_s=31, now=now)
+
+    rule = next(r for r in alerts.default_rules(cfg, history=h)
+                if r.name == "serving_p99_slo")
+    assert rule.for_windows == 1 and rule.threshold == 1.0
+    state = {}
+    value = rule.sample({}, state)
+    assert value is not None and not rule.bad(value)
+    # The slow window is the limiting factor: its budget was not spent.
+    assert state["burn"]["slow"] < 1.0 < state["burn"]["fast"]
+
+    # The legacy single-window rule pages for the same blip after
+    # for_windows bad evaluations — exactly the jitter-pages-someone
+    # behavior the burn rework removes.
+    legacy = alerts.AlertEngine(alerts.default_rules(cfg),
+                                window_s=0.0, for_windows=2)
+    spike = _doc(0, p99=900.0)
+    legacy.evaluate(spike)
+    fired = legacy.evaluate(spike)
+    assert any(t["alert"] == "serving_p99_slo" and t["to"] == "firing"
+               for t in fired)
+
+
+def test_sustained_burn_fires_within_fast_window(tmp_path):
+    """Acceptance: a sustained breach fires well before one fast window
+    elapses — 120 s of 100%-bad samples consume the 72 s slow-window
+    budget (burn_slow > 1) while the fast window reads solidly bad."""
+    now = time.time()
+    cfg, h = _p99_history(tmp_path / "burn", bad_since_s=121, now=now)
+    eng = alerts.AlertEngine(alerts.default_rules(cfg, history=h),
+                             window_s=0.0)
+    fired = eng.evaluate(_doc(0, p99=900.0))
+    assert any(t["alert"] == "serving_p99_slo" and t["to"] == "firing"
+               for t in fired)
+    snap = eng.snapshot()["rules"]["serving_p99_slo"]
+    assert snap["burn"]["fast"] > 1.0 and snap["burn"]["slow"] > 1.0
+
+    # ...and a stale incident (bad an hour ago, clean since) reads
+    # burn_fast ~ 0: min() keeps it silent — no paging for history.
+    cfg2, h2 = _p99_history(tmp_path / "stale", bad_since_s=0, now=now)
+    rule = next(r for r in alerts.default_rules(cfg2, history=h2)
+                if r.name == "serving_p99_slo")
+    assert not rule.bad(rule.sample({}, {}))
+
+
+def test_reject_rate_burn_rule(tmp_path):
+    """The ratio rules measure the fraction of history INTERVALS whose
+    rejected/offered ratio breached the knob — sustained rejection
+    fires, idle history does not."""
+    now = time.time()
+    cfg = _burn_cfg(tmp_path)
+    h = timeseries.TelemetryHistory(cfg)
+    req = rej = 0
+    for i in range(120):
+        t = now - 3600 + i * 30
+        req += 10
+        if t > now - 200:                  # sustained 50% rejection
+            rej += 10
+        h.observe(_doc(0, rejected=rej)
+                  | {"serving": {"requests": req, "rejected": rej,
+                                 "deadline_exceeded": 0,
+                                 "models": {}}}, now=t)
+    rule = next(r for r in alerts.default_rules(cfg, history=h)
+                if r.name == "serving_reject_rate")
+    state = {}
+    value = rule.sample({}, state)
+    assert rule.bad(value), state
+    # Legacy form still available (and used) without a history store.
+    legacy = next(r for r in alerts.default_rules(cfg)
+                  if r.name == "serving_reject_rate")
+    assert legacy.threshold == pytest.approx(cfg.slo_reject_rate)
+
+
+def test_burn_disabled_knob_restores_legacy(tmp_path):
+    cfg = _burn_cfg(tmp_path, slo_burn_fast_s=0.0)
+    h = timeseries.TelemetryHistory(cfg)
+    rule = next(r for r in alerts.default_rules(cfg, history=h)
+                if r.name == "serving_p99_slo")
+    # Legacy: threshold is the ms knob, not the 1.0 burn line.
+    assert rule.threshold == pytest.approx(cfg.slo_p99_ms)

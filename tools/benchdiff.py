@@ -1,0 +1,216 @@
+"""benchdiff — normalize BENCH_*.json schemas and gate on regressions.
+
+The bench trajectory (BENCH_r0N.json, BENCH_serving.json,
+MULTICHIP_r0N.json) has grown three shapes over the PRs: driver wrappers
+(``{n, cmd, rc, tail, parsed}``), bare metric documents, and lists of
+metric documents. Nothing machine-checked it — a perf regression only
+surfaced if a human re-read the numbers. This tool:
+
+1. **normalizes** any of those shapes into a flat
+   ``{dotted.metric.path: number}`` mapping;
+2. **diffs** a candidate run against a baseline run under per-metric
+   tolerances, with direction inferred from the metric name (latency /
+   wall-clock keys are worse when HIGHER; throughput / speedup keys are
+   worse when LOWER; everything else is informational);
+3. exits **non-zero on any regression** — the CI perf gate
+   (.github/workflows/ci.yml ``bench-smoke``), which also proves the
+   gate live against an injected-regression fixture each run.
+
+Usage::
+
+    python -m tools.benchdiff BASELINE.json CANDIDATE.json \
+        [--tolerance 'PATTERN=REL'] [--default-tolerance REL] \
+        [--require-equal 'PATTERN'] [--json]
+
+``PATTERN`` is an ``fnmatch`` glob over the dotted metric path
+(``closed_loop.p99_ms``, ``open_loop.0.p99_ms``, ...). ``REL`` is the
+allowed relative worsening (``0.2`` = candidate may be up to 20% worse).
+``--require-equal`` pins keys (error/mismatch counters) to exact
+equality-or-better regardless of tolerance. Stdlib-only, like every
+tools/ gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Metric-name suffixes whose value is worse when HIGHER (latency,
+#: wall-clock, failure counts).
+HIGHER_IS_WORSE = ("p50_ms", "p99_ms", "wall_s", "errors", "mismatches",
+                   "timeouts", "rejected_503", "other", "compile_s",
+                   "duration_ms", "rc")
+#: ...and worse when LOWER (throughput, speedups, successes).
+LOWER_IS_WORSE = ("rps", "qps", "value", "speedup", "mfu", "bw_util",
+                  "answered", "ok")
+
+
+def normalize(doc: Any, prefix: str = "",
+              out: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    """Flatten one bench document of ANY shipped shape into
+    ``{dotted.path: number}``. Driver wrappers unwrap to their
+    ``parsed`` payload; lists index numerically; non-numeric leaves
+    (metric names, units, command lines) drop out."""
+    if out is None:
+        out = {}
+        # Driver-wrapper shape: the measurement lives under "parsed";
+        # rc is kept (a failing bench run IS a regression).
+        if isinstance(doc, dict) and "parsed" in doc and "cmd" in doc:
+            if "rc" in doc:
+                out["rc"] = float(doc.get("rc") or 0)
+            doc = doc["parsed"]
+    if isinstance(doc, dict):
+        for key, val in sorted(doc.items()):
+            name = f"{prefix}{key}"
+            if isinstance(val, (dict, list)):
+                normalize(val, f"{name}.", out)
+            elif isinstance(val, bool):
+                out[name] = 1.0 if val else 0.0
+            elif isinstance(val, (int, float)):
+                out[name] = float(val)
+    elif isinstance(doc, list):
+        for i, val in enumerate(doc):
+            name = f"{prefix}{i}"
+            if isinstance(val, (dict, list)):
+                normalize(val, f"{name}.", out)
+            elif isinstance(val, bool):
+                out[name] = 1.0 if val else 0.0
+            elif isinstance(val, (int, float)):
+                out[name] = float(val)
+    return out
+
+
+def direction(path: str) -> Optional[str]:
+    """"up" = worse when higher, "down" = worse when lower, None =
+    informational (no gate). Judged on the path's last component."""
+    leaf = path.rsplit(".", 1)[-1]
+    for suffix in HIGHER_IS_WORSE:
+        if leaf == suffix or leaf.endswith("_" + suffix):
+            return "up"
+    for suffix in LOWER_IS_WORSE:
+        if leaf == suffix or leaf.endswith("_" + suffix):
+            return "down"
+    return None
+
+
+def _tolerance_for(path: str, rules: List[Tuple[str, float]],
+                   default: float) -> float:
+    for pattern, tol in rules:
+        if fnmatch.fnmatch(path, pattern):
+            return tol
+    return default
+
+
+def diff(baseline: Dict[str, float], candidate: Dict[str, float],
+         tolerances: Optional[List[Tuple[str, float]]] = None,
+         default_tolerance: float = 0.15,
+         require_equal: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Compare two normalized runs. A metric regresses when it moved in
+    its worse direction by more than its tolerance (relative, against
+    the baseline magnitude; a zero baseline gates on any worsening
+    beyond the tolerance in absolute terms). Metrics present in only
+    one run are reported, not failed — schemas may grow."""
+    tolerances = tolerances or []
+    require_equal = require_equal or []
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[str] = []
+    compared = 0
+    for path in sorted(set(baseline) & set(candidate)):
+        base, cand = baseline[path], candidate[path]
+        pinned = any(fnmatch.fnmatch(path, p) for p in require_equal)
+        dirn = direction(path)
+        if dirn is None and not pinned:
+            continue
+        compared += 1
+        worse = (cand - base) if (dirn == "up" or (pinned and dirn != "down")) \
+            else (base - cand)
+        if pinned:
+            if worse > 0:
+                regressions.append(
+                    {"metric": path, "baseline": base, "candidate": cand,
+                     "limit": base, "why": "pinned equal-or-better"})
+            continue
+        tol = _tolerance_for(path, tolerances, default_tolerance)
+        scale = abs(base) if base else 1.0
+        if worse > tol * scale:
+            limit = (base + tol * scale) if dirn == "up" \
+                else (base - tol * scale)
+            regressions.append(
+                {"metric": path, "baseline": base, "candidate": cand,
+                 "limit": round(limit, 6),
+                 "why": f"{dirn == 'up' and 'rose' or 'fell'} past "
+                        f"{tol:.0%} tolerance"})
+        elif worse < 0:
+            improvements.append(path)
+    return {
+        "ok": not regressions,
+        "compared": compared,
+        "baseline_metrics": len(baseline),
+        "candidate_metrics": len(candidate),
+        "only_baseline": sorted(set(baseline) - set(candidate)),
+        "only_candidate": sorted(set(candidate) - set(baseline)),
+        "regressions": regressions,
+        "improved": len(improvements),
+    }
+
+
+def load(path: str) -> Dict[str, float]:
+    with open(path, encoding="utf-8") as f:
+        return normalize(json.load(f))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.benchdiff",
+        description="diff two bench runs; exit 1 on regression")
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="PATTERN=REL",
+                    help="per-metric relative tolerance, e.g. "
+                         "'*.p99_ms=0.5' (first match wins)")
+    ap.add_argument("--default-tolerance", type=float, default=0.15,
+                    help="relative tolerance for gated metrics without "
+                         "a --tolerance match (default 0.15)")
+    ap.add_argument("--require-equal", action="append", default=[],
+                    metavar="PATTERN",
+                    help="metrics that must be equal-or-better "
+                         "regardless of tolerance (error counters)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    rules: List[Tuple[str, float]] = []
+    for spec in args.tolerance:
+        if "=" not in spec:
+            ap.error(f"--tolerance {spec!r}: expected PATTERN=REL")
+        pattern, _, raw = spec.rpartition("=")
+        try:
+            rules.append((pattern, float(raw)))
+        except ValueError:
+            ap.error(f"--tolerance {spec!r}: REL must be a number")
+
+    report = diff(load(args.baseline), load(args.candidate),
+                  tolerances=rules,
+                  default_tolerance=args.default_tolerance,
+                  require_equal=args.require_equal)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"benchdiff: {report['compared']} gated metrics compared "
+              f"({report['baseline_metrics']} baseline / "
+              f"{report['candidate_metrics']} candidate), "
+              f"{report['improved']} improved")
+        for r in report["regressions"]:
+            print(f"  REGRESSION {r['metric']}: {r['baseline']:g} -> "
+                  f"{r['candidate']:g} (limit {r['limit']:g}; {r['why']})")
+        if report["ok"]:
+            print("benchdiff: OK")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

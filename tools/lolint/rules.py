@@ -1,4 +1,4 @@
-"""The six lolint rules — this repo's hard-won invariants as AST checks.
+"""The lolint rules — this repo's hard-won invariants as AST checks.
 
 Each rule is a class with a ``name``, an ``applies(relpath)`` scope, a
 per-file ``check(pf)`` and an optional whole-tree ``finalize(project)``.
@@ -9,6 +9,7 @@ finding that motivated each one; keep the two in sync when adding rules.
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
@@ -493,6 +494,102 @@ class LogDisciplineRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# metric-doc-coverage
+# ---------------------------------------------------------------------------
+
+class MetricDocCoverageRule(Rule):
+    name = "metric-doc-coverage"
+    description = ("every lo_* Prometheus series name emitted by "
+                   "utils/prometheus.py appears in "
+                   "docs/observability.md")
+
+    PROMETHEUS = f"{PACKAGE}/utils/prometheus.py"
+    DOC = "docs/observability.md"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath == self.PROMETHEUS
+
+    @classmethod
+    def series_names(cls, pf: ParsedFile) -> Dict[str, int]:
+        """Every statically resolvable ``lo_*`` series name (or, when
+        an f-string's placeholder cannot be resolved, its literal
+        prefix) emitted by the exposition renderer, mapped to a source
+        line. f-string placeholders resolve against the NEAREST
+        enclosing ``for <name> in (<string literals>)`` loop — the
+        renderer's per-key loops — so ``f"lo_serving_{key}_total"``
+        expands to the exact series it emits, never a cross-loop
+        cartesian superset."""
+        names: Dict[str, int] = {}
+
+        def visit(node: ast.AST, env: Dict[str, List[str]]) -> None:
+            if isinstance(node, ast.For) and isinstance(
+                    node.target, ast.Name) and isinstance(
+                    node.iter, (ast.Tuple, ast.List)) and node.iter.elts \
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in node.iter.elts):
+                inner = dict(env)
+                inner[node.target.id] = [e.value for e in node.iter.elts]
+                for child in node.body + node.orelse:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str) and node.value.startswith("lo_"):
+                names.setdefault(node.value, node.lineno)
+            elif isinstance(node, ast.JoinedStr):
+                cls._expand_fstring(node, env, names)
+            for child in ast.iter_child_nodes(node):
+                visit(child, env)
+
+        visit(pf.tree, {})
+        return names
+
+    @staticmethod
+    def _expand_fstring(node: ast.JoinedStr, env: Dict[str, List[str]],
+                        names: Dict[str, int]) -> None:
+        parts = node.values
+        if not (parts and isinstance(parts[0], ast.Constant)
+                and str(parts[0].value).startswith("lo_")):
+            return
+        expansions = [""]
+        for p in parts:
+            if isinstance(p, ast.Constant):
+                expansions = [e + str(p.value) for e in expansions]
+            elif isinstance(p, ast.FormattedValue) and isinstance(
+                    p.value, ast.Name) and p.value.id in env:
+                expansions = [e + v for e in expansions
+                              for v in env[p.value.id]]
+            else:
+                # Unresolvable placeholder: fall back to the literal
+                # prefix — the doc then needs at least a lo_<prefix>_*
+                # mention (substring match).
+                names.setdefault(str(parts[0].value), node.lineno)
+                return
+        for name in expansions:
+            names.setdefault(name, node.lineno)
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        pf = project.by_path(self.PROMETHEUS)
+        if pf is None:
+            return
+        doc_path = os.path.join(project.root, self.DOC)
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc_text = f.read()
+        except OSError:
+            doc_text = ""
+        for name, line in sorted(self.series_names(pf).items()):
+            if name not in doc_text:
+                yield Finding(
+                    self.name, pf.path, line, 0,
+                    f"series {name} is emitted on /metrics?format="
+                    "prometheus but documented nowhere in "
+                    f"{self.DOC} — an operator grepping the docs for a "
+                    "dashboard series must find every name the "
+                    "exposition can produce", "")
+
+
+# ---------------------------------------------------------------------------
 # failpoint-coverage
 # ---------------------------------------------------------------------------
 
@@ -610,6 +707,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     ThreadLifecycleRule(),
     HandlerErrorMapRule(),
     LogDisciplineRule(),
+    MetricDocCoverageRule(),
     FailpointCoverageRule(),
 )
 
