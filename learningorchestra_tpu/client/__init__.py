@@ -35,6 +35,16 @@ class JobFailed(RuntimeError):
     pass
 
 
+class JobDeadlineExpired(JobFailed):
+    """A server-side job was killed by the liveness watchdog: it made no
+    progress for ``LO_TPU_JOB_DEADLINE_S`` (hung device program). The
+    failure is retryable INFRASTRUCTURE — the supervisor restarts the
+    pod and the rescan re-runs the job, which resumes from its fit
+    checkpoint — so polling the same dataset again after the pod
+    recovers may find it finished. Subclasses :class:`JobFailed` so
+    existing handlers keep working."""
+
+
 class DeadlineExpired(RuntimeError):
     """A per-call deadline budget ran out client-side: raised instead of
     sending (or retrying) a request whose answer the caller no longer
@@ -310,8 +320,18 @@ class AsyncronousWait:
             if docs:
                 meta = docs[0]
                 if meta.get("error"):
-                    raise JobFailed(
-                        f"{dataset_name}: {meta['error']}")
+                    retries = meta.get("retries")
+                    suffix = (f" (retries={retries})"
+                              if retries else "")
+                    msg = f"{dataset_name}: {meta['error']}{suffix}"
+                    # The watchdog's kill is typed: callers can treat
+                    # "the job hung and will be retried after the pod
+                    # restarts" differently from a deterministic input
+                    # error that would fail identically again.
+                    if str(meta["error"]).startswith(
+                            "interrupted: watchdog"):
+                        raise JobDeadlineExpired(msg)
+                    raise JobFailed(msg)
                 if meta.get("finished"):
                     return meta
             if time.time() > deadline:

@@ -278,6 +278,36 @@ class Settings:
     persist_models: bool = field(
         default_factory=lambda: _env("LO_TPU_PERSIST_MODELS", True, bool)
     )
+    #: Mid-fit checkpoint cadence (utils/fitckpt.py): persist per-family
+    #: fit progress under ``<store_root>/_fitckpt`` every this many
+    #: natural units — gb boost rounds, mlp training iterations, and (at
+    #: every vmapped tree-batch boundary) rf trees — plus the streamed
+    #: design fit's accumulator state at pass boundaries. A retried job
+    #: (supervisor restart, watchdog kill, explicit re-POST) resumes
+    #: from the newest valid checkpoint and produces BIT-IDENTICAL final
+    #: params/metrics to an uninterrupted fit. ``0`` (the default)
+    #: disables checkpointing entirely and keeps today's single-program
+    #: fit path as the oracle (docs/fault_tolerance.md §8).
+    fit_ckpt_rounds: int = field(
+        default_factory=lambda: _env("LO_TPU_FIT_CKPT_ROUNDS", 0)
+    )
+
+    # --- job-tier fault domain (jobs.py watchdog) ---------------------------
+    #: Per-job liveness deadline (seconds): a managed job whose BODY has
+    #: started and then makes no PROGRESS for this long — progress marks
+    #: (``jobs.heartbeat``) fire at boost-round / tree-batch /
+    #: fitting-pass / dispatch boundaries — is failed by the watchdog
+    #: thread with the retryable ``interrupted: watchdog`` prefix, the
+    #: pod is poisoned so the supervisor restarts it under a new mesh
+    #: epoch, and a flight-recorder bundle freezes the evidence. Bounds
+    #: the one phase nothing else bounds: a hung device program after
+    #: SPMD 'go'. Marks land at PROGRAM boundaries (a running device
+    #: program is opaque), so size this above the longest single fit
+    #: program plus cold compile — docs/fault_tolerance.md §8 has the
+    #: granularity table. ``0`` (the default) disables the watchdog.
+    job_deadline_s: float = field(
+        default_factory=lambda: _env("LO_TPU_JOB_DEADLINE_S", 0.0)
+    )
 
     # --- elastic recovery (supervisor.py) ----------------------------------
     #: Automatic re-runs per job whose outputs failed from INFRASTRUCTURE
@@ -307,6 +337,15 @@ class Settings:
     #: worker vanished and the watchdog poisoned this pod).
     health_interval_s: float = field(
         default_factory=lambda: _env("LO_TPU_HEALTH_INTERVAL_S", 2.0)
+    )
+    #: Restart-budget decay window (seconds): after this much CONTINUOUS
+    #: healthy pod uptime the supervisor resets its consumed restart
+    #: count to zero, so budget spent on an incident from hours ago no
+    #: longer dooms tonight's single blip (budget exhaustion used to be
+    #: permanent). A pod that keeps flapping faster than this window
+    #: still exhausts its budget exactly as before. ``0`` disables decay.
+    restart_healthy_s: float = field(
+        default_factory=lambda: _env("LO_TPU_RESTART_HEALTHY_S", 300.0)
     )
 
     # --- observability -----------------------------------------------------

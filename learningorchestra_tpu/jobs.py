@@ -25,8 +25,16 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from learningorchestra_tpu.utils import tracing
+from learningorchestra_tpu.utils import failpoints, tracing
 from learningorchestra_tpu.utils.profiling import op_timer
+from learningorchestra_tpu.utils.structlog import get_logger
+
+log = get_logger("jobs")
+
+#: Deterministic fault-injection site: the head of every progress mark
+#: (``heartbeat``) — ``hang``/``slow`` here simulates a wedge at a
+#: round/pass boundary, which is exactly what the watchdog must catch.
+FP_JOB_PRE_HEARTBEAT = failpoints.declare("job.pre_heartbeat")
 
 #: The currently-running job's record: its body (and anything it calls
 #: on the same thread) records profiling counters — streamed-fit pass
@@ -120,6 +128,51 @@ def record_job_watermarks(*, peak_hbm_bytes: Optional[int] = None,
             prof["fit_resources"] = fr
         rec.profile = prof
 
+#: Job-tier fault counters (process-wide, monotone — the alert engine
+#: reads deltas): watchdog kills and checkpoint resumes. Module-level so
+#: trainers/preprocess can count a resume without holding a JobManager.
+_fault_lock = threading.Lock()
+_fault = {"watchdog_fired_total": 0, "jobs_resumed_total": 0}
+
+
+def fault_snapshot() -> Dict[str, int]:
+    """The ``job_fault`` section of ``/metrics``."""
+    with _fault_lock:
+        return dict(_fault)
+
+
+def heartbeat() -> None:
+    """Progress mark: the running job is ALIVE and advancing. Called at
+    natural boundaries — gb boost-round/checkpoint batches, rf tree
+    batches, mlp iteration segments, streamed-fit pass boundaries, SPMD
+    dispatch round completion — it resets the watchdog's liveness clock
+    (``LO_TPU_JOB_DEADLINE_S`` bounds the gap BETWEEN marks, so a slow
+    but progressing fit survives while a wedged program dies). No-op
+    outside a managed job."""
+    failpoints.fire(FP_JOB_PRE_HEARTBEAT)
+    rec = _job_record.get()
+    if rec is not None:
+        rec.progress_mono = time.monotonic()
+
+
+def record_job_resume(label: str, doc: Dict[str, Any]) -> None:
+    """A fit (or the streamed design fit) resumed from a checkpoint:
+    count it and surface the provenance on the job profile as
+    ``resumed_from[label]`` (round/pass reached, writing epoch) so
+    ``/jobs`` shows what a retry actually skipped."""
+    with _fault_lock:
+        _fault["jobs_resumed_total"] += 1
+    rec = _job_record.get()
+    if rec is None:
+        return
+    with _profile_lock:
+        prof = dict(rec.profile)
+        resumed = dict(prof.get("resumed_from", {}))
+        resumed[label] = dict(doc)
+        prof["resumed_from"] = resumed
+        rec.profile = prof
+
+
 #: Error prefixes marking a job killed by INFRASTRUCTURE — a pod worker
 #: death (watchdog flag, parallel/spmd.py) or a process restart mid-job
 #: (catalog load_all) — rather than by its own inputs. Only these are
@@ -175,6 +228,15 @@ class JobRecord:
     #: Profiling metadata the job body recorded (record_job_profile):
     #: streamed-fit pass counts, per-family device_s, ...
     profile: Dict[str, Any] = field(default_factory=dict)
+    #: Liveness deadline (seconds of no progress before the watchdog
+    #: fails the job); None/0 = unbounded (today's behavior).
+    deadline_s: Optional[float] = None
+    #: Monotonic clock of the last progress mark (``heartbeat``).
+    progress_mono: float = field(default_factory=time.monotonic)
+    #: The body actually began executing: the watchdog only judges
+    #: STARTED jobs — pool queue-wait is a capacity condition, not a
+    #: hung device program, and must never poison the pod.
+    body_started: bool = False
 
     def to_doc(self) -> Dict[str, Any]:
         doc = {
@@ -184,6 +246,8 @@ class JobRecord:
             "duration": (self.finished_at or time.time()) - self.started_at,
             "trace_id": self.trace_id,
         }
+        if self.deadline_s:
+            doc["deadline_s"] = self.deadline_s
         if self.profile:
             doc["profile"] = dict(self.profile)
         return doc
@@ -196,13 +260,113 @@ class JobManager:
     #: beyond this so a long-lived server doesn't leak a record per job.
     MAX_RECORDS = 1000
 
-    def __init__(self, store, max_workers: int = 8):
+    #: Watchdog scan cadence, seconds — cheap (a lock + a few clock
+    #: reads per running job) and fine-grained enough for sub-second
+    #: test deadlines.
+    WATCHDOG_POLL_S = 0.1
+
+    def __init__(self, store, max_workers: int = 8, cfg=None):
+        from learningorchestra_tpu.config import settings as global_settings
+
         self.store = store
+        self.cfg = cfg or global_settings
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="lo-job")
         self._lock = threading.Lock()
         self._jobs: Dict[str, JobRecord] = {}
         self._seq = 0
+        self._watchdog_started = False
+
+    # -- the device-program watchdog ----------------------------------------
+
+    def _ensure_watchdog(self) -> None:
+        """Start the liveness watchdog lazily on the first deadline'd
+        job — a server with LO_TPU_JOB_DEADLINE_S unset never spawns the
+        thread at all."""
+        with self._lock:
+            if self._watchdog_started:
+                return
+            self._watchdog_started = True
+        # thread-lifecycle: owner=JobManager; daemon scan loop that
+        # lives for the process (the manager has no shutdown seam and
+        # the loop only reads/flips job records); exceptions are caught
+        # per scan so the sanitizer never sees it die.
+        threading.Thread(target=self._watchdog_loop, daemon=True,
+                         name="lo-job-watchdog").start()
+
+    def _watchdog_loop(self) -> None:
+        while True:
+            time.sleep(self.WATCHDOG_POLL_S)
+            try:
+                self._watchdog_scan()
+            except Exception:  # noqa: BLE001 — the watchdog must outlive bugs
+                log.exception("job watchdog scan failed")
+
+    def _watchdog_scan(self) -> None:
+        now = time.monotonic()
+        expired: List[JobRecord] = []
+        with self._lock:
+            for rec in self._jobs.values():
+                if (rec.status != "running" or not rec.deadline_s
+                        or not rec.body_started):
+                    continue
+                if now - rec.progress_mono > rec.deadline_s:
+                    rec.status = "failed"
+                    rec.error = (
+                        f"interrupted: watchdog: job {rec.job_id} "
+                        f"({rec.kind}) made no progress for "
+                        f"{rec.deadline_s:.1f}s — device program "
+                        "presumed hung")
+                    rec.finished_at = time.time()
+                    expired.append(rec)
+        for rec in expired:
+            self._expire(rec)
+
+    def _expire(self, rec: JobRecord) -> None:
+        """Post-transition actions for one watchdog-killed job: pollable
+        failure records (the retryable ``interrupted:`` prefix — the
+        restarted pod's rescan re-runs the job, which then resumes from
+        its fit checkpoint), pod poison (the PR 2 machinery: the
+        supervisor's health poll sees the degradation and restarts the
+        pod under a fresh mesh epoch, which is what actually tears down
+        the hung program), and a flight-recorder evidence bundle. The
+        hung thread itself cannot be killed from Python — bounding its
+        damage is the supervisor restart's job."""
+        from learningorchestra_tpu.parallel import spmd
+        from learningorchestra_tpu.utils import flightrec
+
+        with _fault_lock:
+            _fault["watchdog_fired_total"] += 1
+        log.error("%s", rec.error)
+        for name in [n for n in rec.dataset.split(",") if n]:
+            try:
+                if not self.store.get(name).metadata.finished:
+                    self.store.fail(name, rec.error)
+            except Exception:  # noqa: BLE001 — best-effort flagging
+                pass
+        spmd.poison_pod(f"watchdog: job {rec.job_id} ({rec.kind}) hung "
+                        f"past its {rec.deadline_s:.1f}s deadline")
+        flightrec.incident(
+            "job:watchdog",
+            detail={"job_id": rec.job_id, "kind": rec.kind,
+                    "dataset": rec.dataset,
+                    "deadline_s": rec.deadline_s,
+                    "trace_id": rec.trace_id})
+        op_timer.record(f"job.{rec.kind}",
+                        rec.finished_at - rec.started_at)
+
+    def _settle(self, rec: JobRecord, status: str,
+                error: Optional[str] = None) -> bool:
+        """Atomically move a RUNNING record to a terminal state; False
+        when something else (the watchdog) already terminated it — the
+        woken-up job body must never overwrite the watchdog's verdict
+        (or resurrect a job whose datasets were already failed)."""
+        with self._lock:
+            if rec.status != "running":
+                return False
+            rec.status = status
+            rec.error = error
+            return True
 
     def submit(self, kind: str, dataset,
                fn: Callable[[], Any]) -> JobRecord:
@@ -216,6 +380,7 @@ class JobManager:
         """
         datasets: List[str] = ([dataset] if isinstance(dataset, str)
                                else list(dataset))
+        deadline_s = float(self.cfg.job_deadline_s or 0.0) or None
         # Capture the submitting thread's trace position NOW: the pool
         # thread running the job has no ambient context of its own, and
         # the HTTP request whose handler submitted us will be long gone.
@@ -225,7 +390,8 @@ class JobManager:
             rec = JobRecord(job_id=f"{kind}-{self._seq}",
                             dataset=",".join(datasets), kind=kind,
                             trace_id=(parent_ctx.trace_id if parent_ctx
-                                      else tracing.new_id()))
+                                      else tracing.new_id()),
+                            deadline_s=deadline_s)
             self._jobs[rec.job_id] = rec
             if len(self._jobs) > self.MAX_RECORDS:
                 for jid, r in list(self._jobs.items()):
@@ -248,6 +414,11 @@ class JobManager:
             from learningorchestra_tpu.parallel.spmd import PodDegraded
 
             token = _job_record.set(rec)
+            # The liveness clock starts HERE, not at submit: time spent
+            # queued behind the bounded pool never reads as a hang.
+            rec.progress_mono = time.monotonic()
+            rec.body_started = True
+            settled = False
             try:
                 # The job's root span: joins the submitting request's
                 # trace when one was ambient, else roots a new trace
@@ -270,28 +441,37 @@ class JobManager:
                                "mesh_epoch": config.mesh_epoch()}), \
                         resources.job_phase():
                     fn()
-                rec.status = "done"
+                settled = self._settle(rec, "done")
             except PodDegraded as exc:
                 # A job refused (or interrupted) because the pod is
                 # degraded failed from INFRASTRUCTURE, exactly like one
                 # the watchdog flagged — record it under the retryable
                 # prefix so the restarted pod's rescan re-runs it, e.g.
                 # a build queued behind the one whose worker died.
-                rec.status = "failed"
-                rec.error = f"pod failure: {exc}"
+                settled = self._settle(rec, "failed",
+                                       f"pod failure: {exc}")
                 traceback.print_exc()
-                _fail_datasets()
+                if settled:
+                    _fail_datasets()
             except Exception as exc:  # noqa: BLE001 — job boundary
-                rec.status = "failed"
-                rec.error = f"{type(exc).__name__}: {exc}"
+                settled = self._settle(rec, "failed",
+                                       f"{type(exc).__name__}: {exc}")
                 traceback.print_exc()
-                _fail_datasets()
+                if settled:
+                    _fail_datasets()
             finally:
                 _job_record.reset(token)
-                rec.finished_at = time.time()
-                op_timer.record(f"job.{kind}",
-                                rec.finished_at - rec.started_at)
+                # A record the watchdog already terminated keeps its
+                # verdict (and its finished_at — the moment the OPERATOR
+                # learned the job died, not the moment the hung thread
+                # finally woke up).
+                if settled:
+                    rec.finished_at = time.time()
+                    op_timer.record(f"job.{kind}",
+                                    rec.finished_at - rec.started_at)
 
+        if deadline_s:
+            self._ensure_watchdog()
         future: Future = self._pool.submit(run)
         rec._future = future  # type: ignore[attr-defined]
         return rec

@@ -58,7 +58,7 @@ from learningorchestra_tpu.models.registry import get_trainer
 from learningorchestra_tpu.ops import preprocess
 from learningorchestra_tpu.parallel import spmd
 from learningorchestra_tpu.parallel.mesh import MeshRuntime
-from learningorchestra_tpu.utils import resources, tracing
+from learningorchestra_tpu.utils import fitckpt, resources, tracing
 from learningorchestra_tpu.utils.profiling import (
     device_span, device_trace, op_timer, timed)
 
@@ -105,6 +105,7 @@ class ModelBuilder:
         test_ds = self.store.get(test)
         hparams = hparams or {}
         multi = spmd.is_multiprocess()
+        ck_on = int(self.cfg.fit_ckpt_rounds) > 0
         # Read-pipeline traffic of this whole build (streamed-fit scans,
         # ChunkedDesign shard reads, double-buffered device feeding) —
         # recorded on the job profile so a cache/prefetch regression
@@ -140,9 +141,19 @@ class ModelBuilder:
             # must never do.
             streamed = True
             fit_prof: Dict[str, Any] = {}
+            # Pass-boundary checkpoints for the streamed state fit: a
+            # retried build resumes the fitting scans instead of
+            # re-reading the dataset from pass zero. Safe under SPMD
+            # too — only process 0 ever FITS state (workers receive it
+            # pinned in the dispatched spec).
+            design_ckpt = fitckpt.context(
+                self.cfg, dataset=train, family="design",
+                config={"label": label, "steps": list(steps)},
+                snapshot="") if ck_on else None
             X_train, y_train, feature_fields, state = \
                 preprocess.design_matrix_streamed(train_ds, label, steps,
-                                                  profile=fit_prof)
+                                                  profile=fit_prof,
+                                                  ckpt=design_ckpt)
             X_test, y_test, _, _ = preprocess.design_matrix_streamed(
                 test_ds, label, steps, state=state,
                 feature_fields=feature_fields)
@@ -194,6 +205,29 @@ class ModelBuilder:
                 self.store.create(f"{prediction_name}_{c}", parent=test,
                                   extra={"classifier": c, "label": label})
 
+        # Mid-fit checkpoint contexts (utils/fitckpt.py), one per family
+        # with natural segment boundaries. Keyed on everything that
+        # could change the fit's arithmetic — hparams, label/steps,
+        # row snapshot, mesh shape (psum summation grouping) — so a
+        # resume under ANY changed configuration starts fresh. The
+        # single-process paths only: the dispatched SPMD round must run
+        # one identical program on every process, and a mid-fit resume
+        # decision made from local disk state could diverge between
+        # processes (job-level retry + the design-state checkpoint
+        # above still cover the pod path).
+        ckpt_ctxs: Dict[str, Any] = {}
+        if ck_on and not multi:
+            for c in classifiers:
+                if c not in fitckpt.SEGMENTED_FAMILIES:
+                    continue
+                ckpt_ctxs[c] = fitckpt.context(
+                    self.cfg, dataset=train, family=c,
+                    config={"family": c, "hparams": hparams.get(c, {}),
+                            "num_classes": num_classes, "label": label,
+                            "steps": list(steps), "streamed": streamed,
+                            "mesh": dict(self.runtime.mesh.shape)},
+                    snapshot=f"rows={int(len(X_train))}")
+
         def prep_fit(c: str):
             """One family's host-side prep (the trainer's ``host_prep``
             hook — e.g. tree quantile edges from host/chunk-store reads).
@@ -210,10 +244,15 @@ class ModelBuilder:
         def dispatch_fit(c: str, extra: Dict[str, Any]):
             """The family's fit-program dispatch. JAX dispatch is
             asynchronous, so this returns as soon as the fit's device
-            programs are enqueued — the device may still be computing."""
+            programs are enqueued — the device may still be computing.
+            (The checkpointed families' segmented drivers block per
+            segment — pulling params to host at each boundary IS the
+            checkpoint.)"""
+            kw = dict(hparams.get(c, {}), **extra)
+            if c in ckpt_ctxs:
+                kw["ckpt"] = ckpt_ctxs[c]
             return get_trainer(c)(self.runtime, X_train, y_train,
-                                  num_classes, **hparams.get(c, {}),
-                                  **extra)
+                                  num_classes, **kw)
 
         def collect_fit(c: str, model, pre_s: float):
             """The family's probability pass, blocked to completion (the
@@ -229,6 +268,11 @@ class ModelBuilder:
                 name=f"fit.{c}.device")
             op_timer.record(f"fit.{c}", pre_s + device_s)
             op_timer.record(f"fit.{c}.device", device_s)
+            # Progress mark for the job watchdog: a family's device
+            # programs ran to completion — the build is alive.
+            from learningorchestra_tpu import jobs
+
+            jobs.heartbeat()
             return probs, device_s
 
         def finish_host(c: str, model, probs, fit_time: float,
@@ -260,6 +304,15 @@ class ModelBuilder:
                         f"{type(exc).__name__}: {exc}")
             self._save_predictions(f"{prediction_name}_{c}", test_ds,
                                    preds, probs, report)
+            # The family reached its terminal outputs: its mid-fit
+            # checkpoint stream is superseded (a retry of THIS family
+            # can no longer happen — the retry machinery refits only
+            # families whose datasets failed), so reclaim the disk.
+            if c in ckpt_ctxs:
+                ckpt_ctxs[c].clear()
+            from learningorchestra_tpu import jobs
+
+            jobs.heartbeat()
             return report
 
         def fail_report(c: str, exc: Exception) -> FitReport:
@@ -292,6 +345,12 @@ class ModelBuilder:
             if any(rp_delta.values()):
                 prof["read_pipeline"] = rp_delta
             record_job_profile(**prof)
+        if streamed and ck_on and all("error" not in r.metrics
+                                      for r in reports):
+            # Every family completed: the design-state checkpoint has no
+            # retry left to serve — reclaim it (a failed family keeps it
+            # so the retry skips the fitted passes).
+            design_ckpt.clear()
         return reports
 
     def _build_pipelined(self, classifiers, prep_fit, dispatch_fit,

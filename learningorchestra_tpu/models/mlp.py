@@ -79,9 +79,44 @@ def make_train_step(opt):
     return train_step
 
 
+def _ckpt_arrays(params, opt_state):
+    """Flatten (params, opt_state) into a flat name→ndarray dict for the
+    fit-checkpoint store; ``_ckpt_restore`` inverts it against template
+    trees (same structure by construction — the same ``opt.init`` over
+    the same param tree)."""
+    out = {f"p.{k}": np.asarray(v) for k, v in params.items()}
+    leaves = jax.tree_util.tree_leaves(opt_state)
+    out.update({f"o.{i}": np.asarray(v) for i, v in enumerate(leaves)})
+    return out
+
+
+def _ckpt_restore(arrays, mesh, specs, opt):
+    """Rebuild device-placed (params, opt_state) from checkpointed host
+    arrays: params land on their declared tensor-parallel shardings,
+    and each optimizer leaf lands on the sharding a fresh ``opt.init``
+    would give it (adam's moments mirror the params' layouts)."""
+    params = {k[2:]: jax.device_put(v, NamedSharding(mesh, specs[k[2:]]))
+              for k, v in arrays.items() if k.startswith("p.")}
+    template = opt.init(params)
+    tdef = jax.tree_util.tree_structure(template)
+    tleaves = jax.tree_util.tree_leaves(template)
+    loaded = [arrays[f"o.{i}"] for i in range(len(tleaves))]
+    # Mesh-sharded template leaves (adam moments mirror the params'
+    # NamedShardings) get their layout back explicitly; scalar state
+    # (step count) stays uncommitted exactly like a fresh opt.init's —
+    # committing it to one device would conflict with the mesh-placed
+    # params at the jit boundary.
+    placed = [jax.device_put(v, t.sharding)
+              if isinstance(getattr(t, "sharding", None), NamedSharding)
+              else jnp.asarray(v)
+              for v, t in zip(loaded, tleaves)]
+    return params, jax.tree_util.tree_unflatten(tdef, placed)
+
+
 def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
         num_classes: int, seed: int = 0, *, hidden: int = 256,
-        iters: int = 300, lr: float = 1e-2, l2: float = 1e-4) -> TrainedModel:
+        iters: int = 300, lr: float = 1e-2, l2: float = 1e-4,
+        ckpt=None) -> TrainedModel:
 
     mesh = runtime.mesh
     X = as_design(X)
@@ -126,10 +161,48 @@ def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
             return (params, opt_state), loss
         (params, opt_state), losses = jax.lax.scan(
             body, (params, opt_state), None, length=iters)
-        return params, losses
+        return params, opt_state, losses
 
-    params, _ = run(params, opt_state, X_dev, y_dev, mask_dev,
-                    runtime.replicate(np.float32(l2)), iters=iters)
+    l2_dev = runtime.replicate(np.float32(l2))
+    if ckpt is not None and ckpt.enabled and iters > ckpt.every:
+        # Iteration-segmented path (LO_TPU_FIT_CKPT_ROUNDS > 0): the
+        # same jitted scan body runs in segments of ``every`` iters,
+        # carrying (params, opt_state) on device between calls — per-
+        # iteration arithmetic is identical to the single-scan oracle,
+        # so the final params are bit-identical. Checkpoints persist
+        # the carry at segment boundaries; a resume re-places it and
+        # continues from the recorded iteration.
+        from learningorchestra_tpu import jobs
+
+        done = 0
+        loaded = ckpt.load()
+        if loaded is not None:
+            it_done, arrays, cmeta = loaded
+            if 0 < it_done < iters and any(k.startswith("o.")
+                                           for k in arrays):
+                done = it_done
+                params, opt_state = _ckpt_restore(arrays, mesh, specs,
+                                                  opt)
+                from learningorchestra_tpu.utils import fitckpt
+
+                fitckpt.count_resume()
+                jobs.record_job_resume(ckpt.family, {
+                    "iters": int(done), "of": int(iters),
+                    "mesh_epoch": cmeta.get("mesh_epoch")})
+            else:
+                ckpt.clear()
+        every = max(1, int(ckpt.every))
+        while done < iters:
+            k = min(every, iters - done)
+            params, opt_state, _ = run(params, opt_state, X_dev, y_dev,
+                                       mask_dev, l2_dev, iters=k)
+            done += k
+            jobs.heartbeat()
+            if done < iters:
+                ckpt.save(done, _ckpt_arrays(params, opt_state))
+    else:
+        params, _, _ = run(params, opt_state, X_dev, y_dev, mask_dev,
+                           l2_dev, iters=iters)
     return TrainedModel(kind="mlp", params=params,
                         predict_proba_fn=_predict_proba,
                         num_classes=num_classes,

@@ -485,6 +485,58 @@ def _make_newton_gain(lam: float):
 # dt / rf  (classification trees, gini)
 # ---------------------------------------------------------------------------
 
+def _forest_batch_shape(n_trees: int):
+    """(trees per vmapped batch, batch count). Batch = the largest
+    divisor of n_trees ≤ 8, falling back to padded batches of 8 when
+    n_trees has no usable divisor (the discarded pad trees cost < one
+    batch). Shared by the oracle and the checkpoint-segmented path so
+    per-batch shapes — and therefore values — cannot diverge."""
+    tb = max((t for t in range(1, min(8, n_trees) + 1)
+              if n_trees % t == 0), default=1)
+    if tb < 4 and n_trees > 8:
+        tb = 8
+    nb = -(-n_trees // tb)
+    return tb, nb
+
+
+def _one_tree_fn(B, y, valid, *, num_classes, n_trees, max_depth, n_bins,
+                 mtry, min_child_weight, use_kernel):
+    """The per-tree builder (bootstrap + feature subsample + level-wise
+    build), shared verbatim by the oracle's lax.map and the
+    checkpoint-segmented per-batch program. Runs inside shard_map."""
+    d = B.shape[1]
+    # Per-class weights TRANSPOSED to (C, n): the long row axis must
+    # sit in TPU lanes (an (n, C<128) layout pays for 128 lanes).
+    classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
+    base_stats = ((y[None, :] == classes).astype(jnp.float32)
+                  * valid[None, :])
+
+    def one_tree(key):
+        kb, kf = jax.random.split(key)
+        if n_trees == 1:
+            stats = base_stats
+            fmask = jnp.zeros((d,), jnp.float32)
+        else:
+            # Poisson(1) bootstrap weights; identical draw on every
+            # shard would correlate rows, so fold in the shard index.
+            kb = jax.random.fold_in(kb, jax.lax.axis_index(DATA_AXIS))
+            w = jax.random.poisson(kb, 1.0, (B.shape[0],)).astype(
+                jnp.float32)
+            stats = base_stats * w[None, :]
+            # mtry features allowed per tree (same mask on all shards).
+            perm = jax.random.permutation(kf, d)
+            allowed = jnp.zeros((d,), bool).at[perm[:mtry]].set(True)
+            fmask = jnp.where(allowed, 0.0, NEG)
+        feat, thr, internal, leaf = _build_tree(
+            B, stats, fmask, max_depth=max_depth, n_bins=n_bins,
+            gain_fn=_gini_gain, weight_fn=lambda s: s.sum(-1),
+            min_child_weight=min_child_weight, min_gain=1e-9,
+            use_kernel=use_kernel)
+        return feat, thr, internal, leaf
+
+    return one_tree
+
+
 @partial(jax.jit,
          static_argnames=("num_classes", "max_depth", "n_bins", "n_trees",
                           "mesh", "mtry", "use_kernel"))
@@ -492,52 +544,19 @@ def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
                 n_trees, mesh, mtry, min_child_weight=1.0,
                 use_kernel=False):
     """dt (n_trees=1, no bagging) and rf (bootstrap + feature subsampling)."""
-    d = B.shape[1]
 
     def shard_fn(B, y, valid, key):
-        # Per-class weights TRANSPOSED to (C, n): the long row axis must
-        # sit in TPU lanes (an (n, C<128) layout pays for 128 lanes).
-        classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
-        base_stats = ((y[None, :] == classes).astype(jnp.float32)
-                      * valid[None, :])
-
-        def one_tree(key):
-            kb, kf = jax.random.split(key)
-            if n_trees == 1:
-                stats = base_stats
-                fmask = jnp.zeros((d,), jnp.float32)
-            else:
-                # Poisson(1) bootstrap weights; identical draw on every
-                # shard would correlate rows, so fold in the shard index.
-                kb = jax.random.fold_in(kb, jax.lax.axis_index(DATA_AXIS))
-                w = jax.random.poisson(kb, 1.0, (B.shape[0],)).astype(
-                    jnp.float32)
-                stats = base_stats * w[None, :]
-                # mtry features allowed per tree (same mask on all shards).
-                perm = jax.random.permutation(kf, d)
-                allowed = jnp.zeros((d,), bool).at[perm[:mtry]].set(True)
-                fmask = jnp.where(allowed, 0.0, NEG)
-            feat, thr, internal, leaf = _build_tree(
-                B, stats, fmask, max_depth=max_depth, n_bins=n_bins,
-                gain_fn=_gini_gain, weight_fn=lambda s: s.sum(-1),
-                min_child_weight=min_child_weight, min_gain=1e-9,
-                use_kernel=use_kernel)
-            return feat, thr, internal, leaf
-
+        one_tree = _one_tree_fn(
+            B, y, valid, num_classes=num_classes, n_trees=n_trees,
+            max_depth=max_depth, n_bins=n_bins, mtry=mtry,
+            min_child_weight=min_child_weight, use_kernel=use_kernel)
         # Trees build in vmapped batches: a batch's (NL·S, blk) histogram
         # operands stack into one (tb·NL·S, blk) @ (blk, d·n_bins) MXU
         # contraction per row block — ~2× over tree-at-a-time lax.map on
         # rf fits — while the outer sequential map bounds live per-tree
         # row state (stats/weights/assign are O(tb·n), not O(n_trees·n),
-        # so n_trees=100 still fits HBM). Batch = the largest divisor of
-        # n_trees ≤ 8, falling back to padded batches of 8 when n_trees
-        # has no usable divisor (the discarded pad trees cost < one
-        # batch).
-        tb = max((t for t in range(1, min(8, n_trees) + 1)
-                  if n_trees % t == 0), default=1)
-        if tb < 4 and n_trees > 8:
-            tb = 8
-        nb = -(-n_trees // tb)
+        # so n_trees=100 still fits HBM).
+        tb, nb = _forest_batch_shape(n_trees)
         keys = jax.random.split(key, nb * tb)
         outs = jax.lax.map(jax.vmap(one_tree),
                            keys.reshape(nb, tb, *keys.shape[1:]))
@@ -549,6 +568,33 @@ def _fit_forest(B, y, valid, key, *, num_classes, max_depth, n_bins,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=P(), check_vma=False,
     )(B, y, valid, key)
+
+
+@partial(jax.jit,
+         static_argnames=("num_classes", "max_depth", "n_bins", "n_trees",
+                          "mesh", "mtry", "use_kernel"))
+def _fit_forest_batch(B, y, valid, keys_b, *, num_classes, max_depth,
+                      n_bins, n_trees, mesh, mtry, min_child_weight=1.0,
+                      use_kernel=False):
+    """ONE vmapped tree batch of the forest — the checkpoint-segmented
+    complement to ``_fit_forest``'s internal lax.map: the same vmapped
+    ``one_tree`` body over an explicit key slice, so batch b's trees are
+    bit-identical to the oracle's iteration b (``n_trees`` stays the
+    FULL forest size — it selects the bagging branch, not the batch
+    width). Only engaged when ``LO_TPU_FIT_CKPT_ROUNDS > 0``."""
+
+    def shard_fn(B, y, valid, keys_b):
+        one_tree = _one_tree_fn(
+            B, y, valid, num_classes=num_classes, n_trees=n_trees,
+            max_depth=max_depth, n_bins=n_bins, mtry=mtry,
+            min_child_weight=min_child_weight, use_kernel=use_kernel)
+        return jax.vmap(one_tree)(keys_b)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=P(), check_vma=False,
+    )(B, y, valid, keys_b)
 
 
 def _edge_prep(X, n_bins: int = 32, **_ignored) -> dict:
@@ -568,8 +614,52 @@ def _edge_prep(X, n_bins: int = 32, **_ignored) -> dict:
         X if isinstance(X, np.ndarray) else X.sample_rows(200_000), n_bins)}
 
 
+def _run_forest_checkpointed(runtime, ckpt, B_dev, y_dev, valid_dev,
+                             seed, *, num_classes, max_depth, n_bins,
+                             n_trees, mtry, use_kernel):
+    """Batch-at-a-time forest build with a checkpoint at every vmapped
+    tree-batch boundary. Keys, batch shapes and the per-tree body are
+    the oracle's, so the stacked result is bit-identical to one
+    ``_fit_forest`` call; a resume skips the completed batches."""
+    from learningorchestra_tpu import jobs
+    from learningorchestra_tpu.utils import fitckpt
+
+    mesh = runtime.mesh
+    tb, nb = _forest_batch_shape(n_trees)
+    keys = np.asarray(jax.random.split(jax.random.PRNGKey(seed), nb * tb))
+    names = ("feat", "thr", "internal", "leaf")
+    done_b = 0
+    host: dict = {}
+    loaded = ckpt.load()
+    if loaded is not None:
+        trees_done, arrays, meta = loaded
+        if trees_done % tb == 0 and 0 < trees_done <= nb * tb and all(
+                k in arrays for k in names):
+            done_b = trees_done // tb
+            host = {k: arrays[k] for k in names}
+            fitckpt.count_resume()
+            jobs.record_job_resume(ckpt.family, {
+                "trees": int(trees_done), "of": int(n_trees),
+                "mesh_epoch": meta.get("mesh_epoch")})
+        else:
+            ckpt.clear()
+    for b in range(done_b, nb):
+        outs = _fit_forest_batch(
+            B_dev, y_dev, valid_dev,
+            jnp.asarray(keys[b * tb:(b + 1) * tb]),
+            num_classes=num_classes, max_depth=max_depth, n_bins=n_bins,
+            n_trees=n_trees, mesh=mesh, mtry=mtry, use_kernel=use_kernel)
+        seg = {k: np.asarray(a) for k, a in zip(names, outs)}
+        host = ({k: np.concatenate([host[k], seg[k]]) for k in names}
+                if host else seg)
+        jobs.heartbeat()
+        if b + 1 < nb:
+            ckpt.save((b + 1) * tb, host)
+    return tuple(jnp.asarray(host[k][:n_trees]) for k in names)
+
+
 def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
-                   max_depth, n_bins, mtry=None, edges=None):
+                   max_depth, n_bins, mtry=None, edges=None, ckpt=None):
     validate_n_bins(n_bins)
 
     X = as_design(X)
@@ -587,11 +677,19 @@ def _fit_cls_trees(kind, runtime, X, y, num_classes, seed, *, n_trees,
         (np.arange(padded_len) < n).astype(np.float32))
     d = X.shape[1]
     mtry = mtry or max(1, int(np.sqrt(d)))
-    feat, thr, internal, leaf = _fit_forest(
-        B_dev, y_dev, valid_dev, jax.random.PRNGKey(seed),
-        num_classes=num_classes, max_depth=max_depth, n_bins=n_bins,
-        n_trees=n_trees, mesh=runtime.mesh, mtry=mtry,
-        use_kernel=_use_tree_kernel(runtime))
+    use_kernel = _use_tree_kernel(runtime)
+    if (ckpt is not None and ckpt.enabled
+            and _forest_batch_shape(n_trees)[1] > 1):
+        feat, thr, internal, leaf = _run_forest_checkpointed(
+            runtime, ckpt, B_dev, y_dev, valid_dev, seed,
+            num_classes=num_classes, max_depth=max_depth, n_bins=n_bins,
+            n_trees=n_trees, mtry=mtry, use_kernel=use_kernel)
+    else:
+        feat, thr, internal, leaf = _fit_forest(
+            B_dev, y_dev, valid_dev, jax.random.PRNGKey(seed),
+            num_classes=num_classes, max_depth=max_depth, n_bins=n_bins,
+            n_trees=n_trees, mesh=runtime.mesh, mtry=mtry,
+            use_kernel=use_kernel)
     params = {"edges": jnp.asarray(edges), "feat": feat, "thr": thr,
               "internal": internal, "leaf": leaf}
     return TrainedModel(
@@ -623,19 +721,20 @@ def _forest_proba_static(params, X, *, max_depth):
 
 def fit_dt(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
            max_depth: int = 5, n_bins: int = 32,
-           edges=None) -> TrainedModel:
+           edges=None, ckpt=None) -> TrainedModel:
     return _fit_cls_trees("dt", runtime, X, y, num_classes, seed,
                           n_trees=1, max_depth=max_depth, n_bins=n_bins,
-                          edges=edges)
+                          edges=edges, ckpt=ckpt)
 
 
 def fit_rf(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
            n_trees: int = 20, max_depth: int = 5,
            n_bins: int = 32, mtry: Optional[int] = None,
-           edges=None) -> TrainedModel:
+           edges=None, ckpt=None) -> TrainedModel:
     return _fit_cls_trees("rf", runtime, X, y, num_classes, seed,
                           n_trees=n_trees, max_depth=max_depth,
-                          n_bins=n_bins, mtry=mtry, edges=edges)
+                          n_bins=n_bins, mtry=mtry, edges=edges,
+                          ckpt=ckpt)
 
 
 fit_dt.host_prep = _edge_prep
@@ -646,37 +745,47 @@ fit_rf.host_prep = _edge_prep
 # gb  (gradient-boosted trees, binary, logistic loss — as Spark's GBT)
 # ---------------------------------------------------------------------------
 
+def _boost_round_fn(B, yf, valid, *, max_depth, n_bins, step_size, lam,
+                    use_kernel):
+    """The per-round boosting body, shared verbatim by the oracle scan
+    (``_fit_gbt``) and the checkpoint-segmented scan (``_fit_gbt_seg``)
+    so the two paths cannot drift numerically."""
+    gain_fn = _make_newton_gain(lam)
+
+    def boost_round(margin, _):
+        p = jax.nn.sigmoid(margin)
+        g = (p - yf) * valid          # d loss / d margin
+        h = jnp.maximum(p * (1 - p), 1e-6) * valid
+        stats = jnp.stack([g, h], axis=0)          # (2, n) — lanes = n
+        feat, thr, internal, leaf = _build_tree(
+            B, stats, jnp.zeros((B.shape[1],), jnp.float32),
+            max_depth=max_depth, n_bins=n_bins, gain_fn=gain_fn,
+            weight_fn=lambda s: s[..., 1],
+            min_child_weight=1e-3, min_gain=1e-9,
+            use_kernel=use_kernel)
+        leaf_val = -leaf[:, 0] / (leaf[:, 1] + lam)       # (M,)
+        assign = _descend(B, feat, thr, internal, max_depth,
+                          use_kernel=use_kernel)
+        margin = margin + step_size * _sel_table_blocked(leaf_val,
+                                                         assign)
+        return margin, (feat, thr, internal, leaf_val)
+
+    return boost_round
+
+
 @partial(jax.jit,
          static_argnames=("max_depth", "n_bins", "n_rounds", "mesh",
                           "use_kernel"))
 def _fit_gbt(B, y, valid, *, max_depth, n_bins, n_rounds, mesh,
              step_size=0.1, lam=1.0, use_kernel=False):
-    M = 2 ** (max_depth + 1) - 1
-
     def shard_fn(B, y, valid):
         yf = y.astype(jnp.float32)
         margin = jnp.zeros(B.shape[0], jnp.float32)
-        gain_fn = _make_newton_gain(lam)
-
-        def boost_round(margin, _):
-            p = jax.nn.sigmoid(margin)
-            g = (p - yf) * valid          # d loss / d margin
-            h = jnp.maximum(p * (1 - p), 1e-6) * valid
-            stats = jnp.stack([g, h], axis=0)          # (2, n) — lanes = n
-            feat, thr, internal, leaf = _build_tree(
-                B, stats, jnp.zeros((B.shape[1],), jnp.float32),
-                max_depth=max_depth, n_bins=n_bins, gain_fn=gain_fn,
-                weight_fn=lambda s: s[..., 1],
-                min_child_weight=1e-3, min_gain=1e-9,
-                use_kernel=use_kernel)
-            leaf_val = -leaf[:, 0] / (leaf[:, 1] + lam)       # (M,)
-            assign = _descend(B, feat, thr, internal, max_depth,
-                              use_kernel=use_kernel)
-            margin = margin + step_size * _sel_table_blocked(leaf_val,
-                                                             assign)
-            return margin, (feat, thr, internal, leaf_val)
-
-        _, trees = jax.lax.scan(boost_round, margin, None, length=n_rounds)
+        boost_round = _boost_round_fn(
+            B, yf, valid, max_depth=max_depth, n_bins=n_bins,
+            step_size=step_size, lam=lam, use_kernel=use_kernel)
+        _, trees = jax.lax.scan(boost_round, margin, None,
+                                length=n_rounds)
         return trees
 
     return jax.shard_map(
@@ -684,6 +793,66 @@ def _fit_gbt(B, y, valid, *, max_depth, n_bins, n_rounds, mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(), check_vma=False,
     )(B, y, valid)
+
+
+@partial(jax.jit,
+         static_argnames=("max_depth", "n_bins", "n_rounds", "mesh",
+                          "use_kernel"))
+def _fit_gbt_seg(B, y, valid, margin0, *, max_depth, n_bins, n_rounds,
+                 mesh, step_size=0.1, lam=1.0, use_kernel=False):
+    """One SEGMENT of boost rounds for the checkpointed gb path: takes
+    the carried margin in (row-sharded), returns it back out next to the
+    segment's trees — so a fit interrupted between segments resumes from
+    the persisted trees with bit-identical arithmetic (the round body is
+    the oracle's, shared via ``_boost_round_fn``). Only engaged when
+    ``LO_TPU_FIT_CKPT_ROUNDS > 0``; the single-scan oracle above stays
+    today's path otherwise."""
+    def shard_fn(B, y, valid, margin0):
+        yf = y.astype(jnp.float32)
+        boost_round = _boost_round_fn(
+            B, yf, valid, max_depth=max_depth, n_bins=n_bins,
+            step_size=step_size, lam=lam, use_kernel=use_kernel)
+        margin, trees = jax.lax.scan(boost_round, margin0, None,
+                                     length=n_rounds)
+        return trees, margin
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS)), check_vma=False,
+    )(B, y, valid, margin0)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "mesh", "use_kernel"))
+def _gbt_replay_margin(B, feat, thr, internal, leaf_val, step_size, *,
+                       max_depth, mesh, use_kernel=False):
+    """Rebuild the boosting margin from checkpointed trees by replaying
+    each round's margin update — the same sequential
+    ``margin += step_size * leaf_val[descend(B)]`` fold the training
+    scan performs, in the same order, so the resumed margin is
+    bit-identical to the interrupted fit's carry (descent is integer
+    arithmetic; the f32 accumulation order is preserved). Cost is the
+    cheap descent/lookup part of each completed round — the histogram
+    builds, which dominate a round, are never re-executed."""
+    def shard_fn(B, feat, thr, internal, leaf_val, step_size):
+        def one(margin, tree):
+            f, t, it, lv = tree
+            assign = _descend(B, f, t, it, max_depth,
+                              use_kernel=use_kernel)
+            return margin + step_size * _sel_table_blocked(lv, assign), \
+                None
+
+        margin, _ = jax.lax.scan(
+            one, jnp.zeros(B.shape[0], jnp.float32),
+            (feat, thr, internal, leaf_val))
+        return margin
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(), P(), P(), P(), P()),
+        out_specs=P(DATA_AXIS), check_vma=False,
+    )(B, feat, thr, internal, leaf_val, step_size)
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -725,9 +894,64 @@ def _gbt_ovr_proba_static(params, X, *, max_depth):
     return p / jnp.maximum(p.sum(axis=1, keepdims=True), 1e-12)
 
 
+def _run_gbt_checkpointed(runtime, ckpt, B_dev, y_dev, valid_dev, *,
+                          max_depth, n_bins, n_rounds, step_size,
+                          use_kernel):
+    """Segment-at-a-time gb build with a checkpoint every
+    ``ckpt.every`` boost rounds. The carried margin stays on device
+    between segments (row-sharded); on resume it is REPLAYED from the
+    checkpointed trees — the same sequential fold the training scan
+    performs, so the continued fit is bit-identical to an uninterrupted
+    one. Returns the stacked per-round tree params."""
+    from learningorchestra_tpu import jobs
+    from learningorchestra_tpu.utils import fitckpt
+
+    mesh = runtime.mesh
+    names = ("feat", "thr", "internal", "leaf_val")
+    done = 0
+    host: dict = {}
+    margin = None
+    loaded = ckpt.load()
+    if loaded is not None:
+        rounds_done, arrays, meta = loaded
+        if 0 < rounds_done <= n_rounds and all(k in arrays
+                                               for k in names):
+            done = rounds_done
+            host = {k: arrays[k] for k in names}
+            margin = _gbt_replay_margin(
+                B_dev, jnp.asarray(host["feat"]),
+                jnp.asarray(host["thr"]), jnp.asarray(host["internal"]),
+                jnp.asarray(host["leaf_val"]), step_size,
+                max_depth=max_depth, mesh=mesh, use_kernel=use_kernel)
+            fitckpt.count_resume()
+            jobs.record_job_resume(ckpt.family, {
+                "rounds": int(done), "of": int(n_rounds),
+                "mesh_epoch": meta.get("mesh_epoch")})
+        else:
+            ckpt.clear()
+    if margin is None:
+        margin, _ = runtime.shard_rows(
+            np.zeros(int(B_dev.shape[0]), np.float32))
+    every = max(1, int(ckpt.every))
+    while done < n_rounds:
+        k = min(every, n_rounds - done)
+        trees, margin = _fit_gbt_seg(
+            B_dev, y_dev, valid_dev, margin, max_depth=max_depth,
+            n_bins=n_bins, n_rounds=k, mesh=mesh, step_size=step_size,
+            use_kernel=use_kernel)
+        seg = {kk: np.asarray(a) for kk, a in zip(names, trees)}
+        host = ({kk: np.concatenate([host[kk], seg[kk]])
+                 for kk in names} if host else seg)
+        done += k
+        jobs.heartbeat()
+        if done < n_rounds:
+            ckpt.save(done, host)
+    return tuple(jnp.asarray(host[kk]) for kk in names)
+
+
 def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
            n_rounds: int = 20, max_depth: int = 5, n_bins: int = 32,
-           step_size: float = 0.1, edges=None) -> TrainedModel:
+           step_size: float = 0.1, edges=None, ckpt=None) -> TrainedModel:
     """Gradient-boosted trees. Binary is the reference-parity path (one
     booster, exactly Spark 2.4's GBTClassifier). ``num_classes > 2``
     goes BEYOND the reference (whose GBTClassifier refuses multiclass):
@@ -754,10 +978,16 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
     use_kernel = _use_tree_kernel(runtime)
     if num_classes == 2:
         y_dev, _ = runtime.shard_rows(np.asarray(y, np.int32))
-        feat, thr, internal, leaf_val = _fit_gbt(
-            B_dev, y_dev, valid_dev, max_depth=max_depth, n_bins=n_bins,
-            n_rounds=n_rounds, mesh=runtime.mesh,
-            step_size=step_size, use_kernel=use_kernel)
+        if ckpt is not None and ckpt.enabled and n_rounds > 1:
+            feat, thr, internal, leaf_val = _run_gbt_checkpointed(
+                runtime, ckpt, B_dev, y_dev, valid_dev,
+                max_depth=max_depth, n_bins=n_bins, n_rounds=n_rounds,
+                step_size=step_size, use_kernel=use_kernel)
+        else:
+            feat, thr, internal, leaf_val = _fit_gbt(
+                B_dev, y_dev, valid_dev, max_depth=max_depth,
+                n_bins=n_bins, n_rounds=n_rounds, mesh=runtime.mesh,
+                step_size=step_size, use_kernel=use_kernel)
         params = {"edges": jnp.asarray(edges), "feat": feat, "thr": thr,
                   "internal": internal, "leaf_val": leaf_val,
                   "step_size": jnp.float32(step_size)}
@@ -768,6 +998,9 @@ def fit_gb(runtime: MeshRuntime, X, y, num_classes, seed=0, *,
             num_classes=2, hparams=hparams)
     # One-vs-rest: C boosters over the SAME binned matrix (one transfer,
     # one binning program — only the 0/1 labels change per booster).
+    # Mid-fit checkpointing stays off here (per-booster streams would
+    # need per-class keys); the binary reference-parity path is the one
+    # HIGGS-scale fits take.
     y_np = np.asarray(y, np.int32)
     per_class = []
     for k in range(num_classes):

@@ -519,8 +519,28 @@ def _make_acc(step):
     raise PreprocessError(f"op {op!r} fits nothing")  # unreachable
 
 
+def _design_ckpt_payload(state: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Fitted-state dict → the checkpoint store's array payload (JSON
+    bytes as uint8 — the store is npz-shaped). Tuples round-trip as
+    lists, which ``apply_steps`` unpacks identically."""
+    import json as _json
+
+    blob = _json.dumps(state).encode("utf-8")
+    return {"state": np.frombuffer(blob, dtype=np.uint8)}
+
+
+def _design_ckpt_state(arrays) -> Optional[Dict[str, Any]]:
+    import json as _json
+
+    try:
+        return _json.loads(arrays["state"].tobytes().decode("utf-8"))
+    except (KeyError, ValueError, UnicodeDecodeError):
+        return None
+
+
 def _fit_design_state(snap, fields, label: str, steps, n_rows: int,
-                      profile: Optional[Dict] = None) -> Dict:
+                      profile: Optional[Dict] = None,
+                      ckpt=None) -> Dict:
     """Fused streaming fit over ONE pinned chunk snapshot; returns the
     fitted state (same contract and — to fp-accumulation order — same
     values as :func:`_fit_design_state_unfused`).
@@ -547,8 +567,37 @@ def _fit_design_state(snap, fields, label: str, steps, n_rows: int,
         need_vocab = probe.dtype == object
     label_uniq: set = set()
     groups = _fusion_groups(steps)
+    done_groups = 0
+    if ckpt is not None and ckpt.enabled:
+        # Pass-boundary checkpoints (LO_TPU_FIT_CKPT_ROUNDS > 0): the
+        # partial fitted state persists after each fusion group's scan,
+        # keyed on the pinned snapshot's row count — every pass of one
+        # fit (and of its resume) reads the same pinned rows, so the
+        # resumed state is exactly what the interrupted fit had.
+        ckpt.snapshot = f"rows={n_rows}"
+        loaded = ckpt.load()
+        if loaded is not None:
+            g_done, arrays, cmeta = loaded
+            blob = _design_ckpt_state(arrays)
+            if blob is not None and 0 < g_done <= len(groups):
+                state = blob
+                done_groups = g_done
+                if "__label_vocab__" in state:
+                    need_vocab = False
+                from learningorchestra_tpu import jobs
+                from learningorchestra_tpu.utils import fitckpt as _fck
+
+                _fck.count_resume()
+                jobs.record_job_resume(ckpt.family, {
+                    "passes": int(g_done),
+                    "of": len(groups) + (1 if need_vocab else 0),
+                    "mesh_epoch": cmeta.get("mesh_epoch")})
+            else:
+                ckpt.clear()
     passes = 0
     for gi, group in enumerate(groups):
+        if gi < done_groups:
+            continue                       # resumed past this pass
         prefix = steps[:group[0]]
         accs = {i: _make_acc(steps[i]) for i in group}
         take_label = need_vocab and gi == 0
@@ -567,6 +616,12 @@ def _fit_design_state(snap, fields, label: str, steps, n_rows: int,
             state["__label_vocab__"] = {
                 v: j for j, v in enumerate(sorted(label_uniq))}
             need_vocab = False
+        if ckpt is not None and ckpt.enabled:
+            from learningorchestra_tpu import jobs
+
+            jobs.heartbeat()
+            if gi + 1 < len(groups) or need_vocab:
+                ckpt.save(gi + 1, _design_ckpt_payload(state))
     if need_vocab:
         # No fitting step to ride along with: one label-column scan.
         passes += 1
@@ -654,7 +709,8 @@ def design_matrix_streamed(ds: Dataset, label: str,
                            feature_fields: Optional[List[str]] = None,
                            n_rows: Optional[int] = None,
                            need_y: bool = True,
-                           profile: Optional[Dict] = None):
+                           profile: Optional[Dict] = None,
+                           ckpt=None):
     """Streamed analogue of ``design_matrix``: same return contract
     ``(X, y, feature_fields, state)`` but X is a :class:`ChunkedDesign`
     and nothing consolidates the dataset. ``state=None`` fits it with
@@ -674,7 +730,7 @@ def design_matrix_streamed(ds: Dataset, label: str,
     steps = [dict(s) for s in steps] or [dict(s) for s in _DEFAULT_STEPS]
     if state is None:
         state = _fit_design_state(snap, ds.metadata.fields, label, steps,
-                                  n_rows, profile=profile)
+                                  n_rows, profile=profile, ckpt=ckpt)
     else:
         state = dict(state)
     y = None

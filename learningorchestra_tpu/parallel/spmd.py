@@ -491,6 +491,18 @@ def _set_pod_error(reason: str) -> None:
     _pod_error = (mesh_epoch(), reason)
 
 
+def poison_pod(reason: str) -> None:
+    """Externally-detected degradation (the job watchdog finding a hung
+    device program): poison this incarnation exactly like a mid-job
+    worker death — later dispatches fail fast, ``/cluster`` reports the
+    reason, and the supervisor's health poll restarts the pod under the
+    next mesh epoch (which is what actually tears the hung program
+    down). Epoch-scoped like every poison: the restarted incarnation
+    reads healthy with no manual clearing."""
+    log.error("pod poisoned: %s", reason)
+    _set_pod_error(reason)
+
+
 def pod_error() -> Optional[str]:
     """The reason this pod is degraded, or None while healthy. Poison
     recorded under a previous mesh epoch is stale — the supervisor
@@ -562,8 +574,16 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
     require_pod_health()
     for name in inputs:
         store.save(name)
+    from learningorchestra_tpu import jobs
+
     with dispatch_guard():
         dispatch(make_spec() if callable(make_spec) else make_spec)
+        # Progress mark: every worker acked ready and 'go' went out —
+        # the job watchdog's liveness clock restarts here, so its
+        # deadline bounds the one phase nothing else bounds: the 'go'
+        # phase of the dispatched device program (connect and prep have
+        # their own timeouts in _JobChannel.dispatch).
+        jobs.heartbeat()
         stop = threading.Event()
 
         def on_death(reason: str) -> None:
@@ -600,6 +620,9 @@ def dispatch_job(store, inputs, make_spec, outputs=()):
             with channel._lock:
                 rnd = channel._round
             channel.drain_spans(rnd)
+            # The workers' span/watermark shipments arriving is itself
+            # progress: the pod-wide program completed end to end.
+            jobs.heartbeat()
         # The compute may have completed on this process even though a
         # worker died (death after its last collective): the outputs were
         # already flagged failed, so surface the degradation to the caller

@@ -59,7 +59,7 @@ class App:
         if recover and self.cfg.persist:
             self.store.load_all(resume_ingests=True)
         self.runtime = MeshRuntime(self.cfg)
-        self.jobs = JobManager(self.store)
+        self.jobs = JobManager(self.store, cfg=self.cfg)
         # Interrupted ingests restart from their last journal-committed
         # source byte instead of failing (the reference restarted a crashed
         # ingest from zero — or rather, never: finished stayed false
@@ -694,7 +694,9 @@ class App:
         so scrape cadence is evaluation cadence — and its state rides
         back in the same document, so an alert can never fire on a
         number the operator cannot see."""
+        from learningorchestra_tpu import jobs as jobs_module
         from learningorchestra_tpu.catalog import readpipe
+        from learningorchestra_tpu.utils import fitckpt
         from learningorchestra_tpu.utils.profiling import op_timer
 
         by_status: dict = {}
@@ -704,6 +706,11 @@ class App:
         doc = {"state": "draining" if self.draining else "serving",
                "ops": op_timer.snapshot(),
                "jobs": by_status,
+               # Job-tier fault counters (watchdog kills, checkpoint
+               # resumes) + the fit-checkpoint store's disk footprint —
+               # the resumable-fit plane's health at a glance.
+               "job_fault": jobs_module.fault_snapshot(),
+               "fit_checkpoints": fitckpt.disk_snapshot(self.cfg),
                "integrity": self.store.integrity_snapshot(),
                "read_pipeline": readpipe.snapshot(),
                "serving": self.predictor.snapshot(),

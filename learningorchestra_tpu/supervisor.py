@@ -259,6 +259,7 @@ class Supervisor:
         ``/cluster`` responder)."""
         self._spawn_all()
         next_health = time.time() + self.cfg.health_interval_s
+        healthy_since = time.time()
         while not self._stop.is_set():
             if self._planned.is_set():
                 self._planned.clear()
@@ -275,6 +276,7 @@ class Supervisor:
                 self._advance_epoch()
                 next_health = time.time() + self.cfg.health_interval_s
                 self._spawn_all()
+                healthy_since = time.time()
                 continue
             codes = [p.poll() for p in self._procs]
             if all(c == 0 for c in codes):
@@ -309,6 +311,21 @@ class Supervisor:
                                 f"{self._read_epoch_file()}")
                     follow = True
             if incident is None:
+                # Restart-budget decay: after LO_TPU_RESTART_HEALTHY_S
+                # of CONTINUOUS healthy uptime, consumed budget resets —
+                # an incident from hours ago must not doom tonight's
+                # single blip (exhaustion used to be permanent). A pod
+                # flapping faster than the window never reaches here
+                # with budget consumed long enough to reset, so repeated
+                # failure still exhausts exactly as before.
+                if (self.restarts > 0 and self.cfg.restart_healthy_s > 0
+                        and time.time() - healthy_since
+                        >= self.cfg.restart_healthy_s):
+                    log.info(
+                        "pod healthy for %.0fs: restart budget restored "
+                        "(%d restart(s) forgiven)",
+                        self.cfg.restart_healthy_s, self.restarts)
+                    self.restarts = 0
                 self._stop.wait(self.POLL_S)
                 continue
             log.warning("pod incident at epoch %d: %s", self.epoch, incident)
@@ -349,6 +366,7 @@ class Supervisor:
             self._advance_epoch()
             next_health = time.time() + self.cfg.health_interval_s
             self._spawn_all()
+            healthy_since = time.time()
         self._kill_all()
         return 0
 

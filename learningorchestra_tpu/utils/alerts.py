@@ -518,6 +518,14 @@ def default_rules(cfg: Settings, history=None) -> List[AlertRule]:
                 "the model is re-saved or deleted",
         sample=_quarantined_models, threshold=0.5, for_windows=1))
     rules.append(AlertRule(
+        name="job_watchdog_fired", severity="critical",
+        summary="the job watchdog killed a hung device program this "
+                "window (no progress past LO_TPU_JOB_DEADLINE_S); the "
+                "pod is poisoned pending a supervisor restart and the "
+                "retried job will resume from its fit checkpoint",
+        sample=counter_delta("job_fault", "watchdog_fired_total"),
+        threshold=0.0, for_windows=1))
+    rules.append(AlertRule(
         name="pod_degraded", severity="critical",
         summary="a pod worker died mid-job; mesh jobs fail fast until "
                 "the supervisor restarts the pod",

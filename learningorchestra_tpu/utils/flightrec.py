@@ -97,6 +97,12 @@ class FlightRecorder:
         self._seq = 0
         self._counters = {"dumped": 0, "suppressed": 0, "errors": 0,
                           "pruned": 0}
+        #: Staging dirs of dumps currently being written: two triggers
+        #: can dump concurrently (the batcher's quarantine incident and
+        #: the alert engine's firing transition race on real servers),
+        #: and the completing dump's prune must sweep only ORPHANED
+        #: ``.tmp-`` debris, never a live sibling's staging dir.
+        self._inflight: set = set()
 
     @property
     def root(self) -> str:
@@ -129,6 +135,8 @@ class FlightRecorder:
             seq = self._seq
         bundle_id = (time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
                      + f"-{seq:03d}-{_slug(reason)}")
+        with self._lock:
+            self._inflight.add(f".tmp-{bundle_id}")
         try:
             return self._write(bundle_id, reason, detail, doc, now)
         except Exception as exc:  # noqa: BLE001 — never a second incident
@@ -136,6 +144,9 @@ class FlightRecorder:
                 self._counters["errors"] += 1
             log.error("flight-recorder dump failed (%s): %s", reason, exc)
             return None
+        finally:
+            with self._lock:
+                self._inflight.discard(f".tmp-{bundle_id}")
 
     def _write(self, bundle_id: str, reason: str, detail: Any,
                doc: Optional[Dict[str, Any]], now: float) -> str:
@@ -183,7 +194,10 @@ class FlightRecorder:
         except OSError:
             return
         keep = max(1, int(self.cfg.flightrec_keep))
-        stale = [e for e in entries if e.startswith(".tmp-")]
+        with self._lock:
+            inflight = set(self._inflight)
+        stale = [e for e in entries
+                 if e.startswith(".tmp-") and e not in inflight]
         live = [e for e in entries if not e.startswith(".tmp-")]
         doomed = stale + live[:-keep] if len(live) > keep else stale
         for e in doomed:

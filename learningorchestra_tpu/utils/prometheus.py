@@ -134,6 +134,34 @@ def render(doc: Dict[str, Any]) -> str:
         for status, n in sorted(jobs.items()):
             w.sample("lo_jobs", {"status": status}, n)
 
+    fault = doc.get("job_fault") or {}
+    if fault:
+        w.header("lo_job_watchdog_fired_total", _COUNTER,
+                 "Jobs killed by the liveness watchdog (no progress "
+                 "past LO_TPU_JOB_DEADLINE_S — hung device program)")
+        w.sample("lo_job_watchdog_fired_total", None,
+                 fault.get("watchdog_fired_total", 0))
+        w.header("lo_jobs_resumed_total", _COUNTER,
+                 "Fits resumed from a mid-fit checkpoint instead of "
+                 "restarting from scratch")
+        w.sample("lo_jobs_resumed_total", None,
+                 fault.get("jobs_resumed_total", 0))
+
+    fck = doc.get("fit_checkpoints") or {}
+    if fck:
+        w.header("lo_fit_checkpoint_bytes", _GAUGE,
+                 "Bytes of fit-progress checkpoints under "
+                 "<store_root>/_fitckpt")
+        w.sample("lo_fit_checkpoint_bytes", None, fck.get("bytes", 0))
+        w.header("lo_fit_checkpoint_files", _GAUGE,
+                 "Checkpoint payload/sidecar files on disk")
+        w.sample("lo_fit_checkpoint_files", None, fck.get("files", 0))
+        for key in ("writes", "resumes", "discarded"):
+            name = f"lo_fit_checkpoint_{key}_total"
+            w.header(name, _COUNTER,
+                     f"Fit-checkpoint store {key} this process")
+            w.sample(name, None, fck.get(key, 0))
+
     for section, prefix, mtype, help_text in (
             ("read_pipeline", "lo_read_pipeline", _COUNTER,
              "Chunk-read pipeline counter"),

@@ -1,12 +1,15 @@
 """Failpoint-sweep child (tests/test_failpoints.py).
 
 Runs one deterministic catalog workload that traverses EVERY registered
-``catalog.* / ingest.* / store.*`` failpoint site. The parent arms one
-site in ``crash`` mode per run (``LO_TPU_FAILPOINTS=<site>=crash``) and
-asserts the child died with ``failpoints.CRASH_EXIT_CODE`` at that exact
-I/O boundary; it then recovers the store and checks the journaled-prefix
-+ checksum invariants. With no failpoint armed the workload completes and
-writes ``done.json`` (the control run, which also records expected row
+``catalog.* / ingest.* / store.* / fit.*`` failpoint site. The parent
+arms one site in ``crash`` mode per run
+(``LO_TPU_FAILPOINTS=<site>=crash``) and asserts the child died with
+``failpoints.CRASH_EXIT_CODE`` at that exact I/O boundary; it then
+recovers the store and checks the journaled-prefix + checksum
+invariants — and, for the fit-checkpoint sites, that whatever
+checkpoint a resume would trust is a fully-valid pair, never a torn
+one. With no failpoint armed the workload completes and writes
+``done.json`` (the control run, which also records expected row
 counts).
 
 Run as: python tests/failpoint_child.py <root>
@@ -65,6 +68,19 @@ store2.load("tab")
 n_ing = len(next(iter(store2.get("ing").columns.values())))
 n_tab = len(next(iter(store2.get("tab").columns.values())))
 assert n_tab == 200, n_tab
+
+# -- 4. fit-progress checkpoints ----------------------------------------------
+# Hits: fit.ckpt.pre_rename (two immutable commits), fit.ckpt.pre_read
+# (the resume-side enumeration). A crash at either boundary must leave
+# the newest fully-durable pair as the one a resume trusts.
+from learningorchestra_tpu.utils import fitckpt  # noqa: E402
+
+fctx = fitckpt.context(cfg, dataset="ck", family="gb",
+                       config={"v": 1}, snapshot="rows=10", every=1)
+fctx.save(1, {"feat": np.arange(4, dtype=np.int32)})
+fctx.save(2, {"feat": np.arange(8, dtype=np.int32)})
+loaded = fctx.load()
+assert loaded is not None and loaded[0] == 2, loaded
 
 with open(os.path.join(root, "done.json"), "w") as f:
     json.dump({"ing_rows": n_ing, "tab_rows": n_tab}, f)

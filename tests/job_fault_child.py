@@ -1,0 +1,80 @@
+"""Single-process pod child for the job-tier fault-domain e2e tests
+(tests/test_job_fault.py).
+
+Incarnation 0 submits an async gb build whose fault is armed via
+``LO_TPU_FAILPOINTS`` in the supervisor's env — either a ``crash`` at a
+checkpoint commit (SIGKILL-mid-fit shape) or a ``hang`` at a progress
+mark (the wedged-device-program shape the watchdog must bound). Later
+incarnations (``LO_TPU_MESH_EPOCH`` > 0) DISARM the failpoint, so the
+recovery rescan's retried job runs clean — resuming from whatever fit
+checkpoint the interrupted incarnation committed.
+
+Run as: python tests/job_fault_child.py <root> <http_port>
+[job_deadline_s=0].
+"""
+
+import os
+import sys
+
+root, http_port = sys.argv[1], int(sys.argv[2])
+deadline_s = float(sys.argv[3]) if len(sys.argv) > 3 else 0.0
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)      # one CPU device: fastest child
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from learningorchestra_tpu import config as _config  # noqa: E402
+from learningorchestra_tpu.config import Settings  # noqa: E402
+from learningorchestra_tpu.utils import failpoints  # noqa: E402
+
+epoch = _config.mesh_epoch()
+if epoch > 0:
+    # The fault belongs to incarnation 0 only: the supervisor re-spawns
+    # us with the same env, so the retried incarnation disarms.
+    failpoints.configure(None)
+
+cfg = Settings()
+cfg.store_root = os.path.join(root, "store")
+cfg.persist = True
+cfg.host = "127.0.0.1"
+cfg.port = http_port
+cfg.fit_ckpt_rounds = 1
+cfg.job_deadline_s = deadline_s
+
+from learningorchestra_tpu.serving.app import App  # noqa: E402
+
+app = App(cfg)           # epoch >= 1: the recovery rescan resubmits here
+
+
+def make_split(seed, n):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.int64)
+    return {**{f"f{i}": X[:, i] for i in range(3)}, "label": y}
+
+
+HPARAMS = {"gb": {"n_rounds": 8, "max_depth": 3}}
+
+if epoch == 0 and not app.store.exists("j_train"):
+    app.store.create("j_train", columns=make_split(0, 400), finished=True)
+    app.store.create("j_test", columns=make_split(1, 200), finished=True)
+    # Submit the async build exactly as POST /models sync=false does:
+    # metadata-first output carrying the re-runnable job spec.
+    job_spec = {"kind": "model_builder", "train": "j_train",
+                "test": "j_test", "pred_name": "j_pred",
+                "classifiers": ["gb"], "label": "label",
+                "steps": [], "hparams": HPARAMS}
+    app.store.create("j_pred_gb", parent="j_test",
+                     extra={"classifier": "gb", "label": "label",
+                            "job": job_spec})
+    app.jobs.submit(
+        "model_builder", ["j_pred_gb"],
+        lambda: app.builder.build("j_train", "j_test", "j_pred", ["gb"],
+                                  "label", hparams=HPARAMS,
+                                  existing=True))
+
+print(f"job-fault child serving at epoch {epoch}", flush=True)
+app.serve()              # blocks; the supervisor kills/restarts us

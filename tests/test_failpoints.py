@@ -391,11 +391,33 @@ def _run_child(root: str, env_extra: dict) -> subprocess.CompletedProcess:
 
 
 def _sweep_sites():
-    # Import for the side effect of declaring every data-plane site.
+    # Import for the side effect of declaring every data-plane site
+    # (the fit-checkpoint store's write/read windows included).
     import learningorchestra_tpu.catalog.ingest  # noqa: F401
+    import learningorchestra_tpu.utils.fitckpt  # noqa: F401
     return [s for s in failpoints.sites()
-            if s.startswith(("catalog.", "ingest.", "store."))
+            if s.startswith(("catalog.", "ingest.", "store.", "fit."))
             and not s.startswith("test.")]
+
+
+def _assert_fitckpt_recovered(cfg, site):
+    """Post-crash invariant for the checkpoint store: whatever a resume
+    would load is a fully-valid pair — the crash left either the
+    previous durable checkpoint or (first-commit crash) nothing, never
+    a torn checkpoint that gets trusted."""
+    from learningorchestra_tpu.utils import fitckpt
+
+    ctx = fitckpt.context(cfg, dataset="ck", family="gb",
+                          config={"v": 1}, snapshot="rows=10", every=1)
+    got = ctx.load()
+    if site == "fit.ckpt.pre_read":
+        # the crash hit the read; both commits had landed
+        assert got is not None and got[0] == 2, got
+    if got is not None:
+        progress, arrays, _meta = got
+        assert progress in (1, 2)
+        np.testing.assert_array_equal(
+            arrays["feat"], np.arange(4 * progress, dtype=np.int32))
 
 
 def test_control_child_completes(tmp_path):
@@ -457,4 +479,30 @@ def test_crash_sweep_recovers_to_journaled_prefix(tmp_path, site):
     store.create("post", columns={"y": np.arange(5)})
     store.save("post")
     assert store.scrub("post")["ok"]
+    _assert_fitckpt_recovered(cfg, site)
     shutil.rmtree(root, ignore_errors=True)
+
+
+@pytest.mark.slow
+def test_crash_at_second_checkpoint_commit_preserves_previous(tmp_path):
+    """The satellite's exact claim: a crash MID-checkpoint (the second
+    commit's pre-rename window — payload staged, nothing committed)
+    must leave the PREVIOUS valid checkpoint as the one a resume
+    trusts, never a torn one."""
+    root = str(tmp_path)
+    _mk_csv(root)
+    proc = _run_child(root, {failpoints.ENV_VAR:
+                             "fit.ckpt.pre_rename=crash:2"})
+    assert proc.returncode == failpoints.CRASH_EXIT_CODE, \
+        proc.stderr[-2000:]
+    cfg = Settings()
+    cfg.store_root = os.path.join(root, "store")
+    cfg.persist = True
+    from learningorchestra_tpu.utils import fitckpt
+
+    ctx = fitckpt.context(cfg, dataset="ck", family="gb",
+                          config={"v": 1}, snapshot="rows=10", every=1)
+    progress, arrays, _meta = ctx.load()
+    assert progress == 1
+    np.testing.assert_array_equal(arrays["feat"],
+                                  np.arange(4, dtype=np.int32))

@@ -1,0 +1,365 @@
+"""Job-tier fault domain: device-program watchdog + resumable retries.
+
+Tier-1: the watchdog fails a stalled managed job within its liveness
+deadline with the retryable ``interrupted: watchdog`` prefix (pollable
+dataset failure, fault counter, flight-recorder bundle, pod poison) and
+never lets the woken-up job body overwrite the verdict; heartbeats keep
+slow-but-progressing jobs alive; the failure is retry-selectable; the
+``job_watchdog_fired`` alert fires on the counter delta; the client
+raises the typed ``JobDeadlineExpired``.
+
+Slow lane: two supervised end-to-end loops through a real child server
+(tests/job_fault_child.py) — a crash at a gb checkpoint commit
+(SIGKILL-mid-fit shape) whose retried job RESUMES from the durable
+checkpoint with fewer re-executed rounds than the total, and a real
+``hang`` at a progress mark that the watchdog + supervisor + rescan
+turn into a bounded, fully automatic recovery. No test ever waits on an
+unbounded hang: the stalls are either ``slow``-mode (seconds) or killed
+by the supervisor.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from learningorchestra_tpu import jobs as jobs_module
+from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.jobs import JobManager, select_retry_groups
+from learningorchestra_tpu.parallel import spmd
+from learningorchestra_tpu.utils import failpoints, flightrec
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "job_fault_child.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_pod_state(monkeypatch):
+    """Watchdog tests poison the pod on purpose; every test starts (and
+    leaves) the process unpoisoned and failpoint-free."""
+    monkeypatch.setattr(spmd, "_pod_error", None)
+    monkeypatch.delenv("LO_TPU_MESH_EPOCH", raising=False)
+    failpoints.reset()
+    yield
+    spmd._pod_error = None
+    failpoints.reset()
+    flightrec.set_recorder(None)
+
+
+def _mk_cfg(tmp_path, deadline_s: float) -> Settings:
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.persist = True
+    cfg.job_deadline_s = deadline_s
+    return cfg
+
+
+# -- tier-1: the watchdog -----------------------------------------------------
+
+def test_watchdog_fails_stalled_job_retryably(tmp_path):
+    cfg = _mk_cfg(tmp_path, deadline_s=0.4)
+    store = DatasetStore(cfg)
+    store.create("wd", extra={"job": {"kind": "projection",
+                                      "parent": "p", "name": "wd",
+                                      "fields": ["x"]}})
+    rec_dir = flightrec.FlightRecorder(cfg)
+    flightrec.set_recorder(rec_dir)
+    jm = JobManager(store, cfg=cfg)
+    before = jobs_module.fault_snapshot()["watchdog_fired_total"]
+    release = threading.Event()
+
+    def stalled():
+        # A stall, not a real hang: the body wakes AFTER the watchdog
+        # verdict so the overwrite guard is exercised, and the test
+        # never waits on anything unbounded.
+        release.wait(5.0)
+
+    rec = jm.submit("projection", "wd", stalled)
+    # the record flips first, the post-transition actions (dataset
+    # failure, poison, bundle) land just after — wait for the LAST one
+    deadline = time.time() + 10
+    while time.time() < deadline and not (
+            rec.status == "failed" and spmd.pod_error()
+            and rec_dir.list()):
+        time.sleep(0.05)
+    assert rec.status == "failed"
+    assert rec.error.startswith("interrupted: watchdog"), rec.error
+    # pollable failure on the dataset, under the RETRYABLE prefix
+    meta = store.get("wd").metadata
+    assert meta.finished and meta.error.startswith("interrupted: watchdog")
+    groups = select_retry_groups(store.metadata_docs(), max_retries=1)
+    assert groups and groups[0]["datasets"] == ["wd"]
+    # counter, pod poison, evidence bundle
+    assert jobs_module.fault_snapshot()["watchdog_fired_total"] == \
+        before + 1
+    assert "watchdog" in (spmd.pod_error() or "")
+    bundles = rec_dir.list()
+    assert bundles and bundles[0]["reason"] == "job:watchdog", bundles
+    assert bundles[0]["detail"]["job_id"] == rec.job_id
+    # the woken-up body must NOT overwrite the watchdog's verdict
+    release.set()
+    jm.wait_all(timeout=10)
+    assert rec.status == "failed"
+    assert rec.error.startswith("interrupted: watchdog")
+
+
+def test_heartbeats_keep_slow_but_progressing_job_alive(tmp_path):
+    cfg = _mk_cfg(tmp_path, deadline_s=0.5)
+    store = DatasetStore(cfg)
+    store.create("slow")
+    jm = JobManager(store, cfg=cfg)
+
+    def slow_but_alive():
+        from learningorchestra_tpu import jobs
+
+        # total wall (1.2 s) far exceeds the 0.5 s liveness deadline,
+        # but every mark resets the clock — the job must survive.
+        for _ in range(8):
+            time.sleep(0.15)
+            jobs.heartbeat()
+
+    rec = jm.submit("ingest", "slow", slow_but_alive)
+    jm.wait_all(timeout=30)
+    assert rec.status == "done", rec.error
+    assert spmd.pod_error() is None
+
+
+def test_pool_queue_wait_never_counts_as_a_hang(tmp_path):
+    """A job waiting in the bounded worker pool has run zero code: the
+    liveness clock starts at body start, so queue-wait past the deadline
+    is a capacity condition — the job runs when its turn comes and the
+    pod is never poisoned for it."""
+    cfg = _mk_cfg(tmp_path, deadline_s=0.3)
+    store = DatasetStore(cfg)
+    store.create("head")
+    store.create("queued")
+    jm = JobManager(store, max_workers=1, cfg=cfg)
+
+    def alive_for(total, step=0.1):
+        from learningorchestra_tpu import jobs
+
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < total:
+            time.sleep(step)
+            jobs.heartbeat()
+
+    head = jm.submit("ingest", "head", lambda: alive_for(0.8))
+    queued = jm.submit("ingest", "queued", lambda: alive_for(0.1))
+    jm.wait_all(timeout=30)
+    assert head.status == "done", head.error
+    assert queued.status == "done", queued.error   # queued 0.8s > 0.3s
+    assert spmd.pod_error() is None
+
+
+def test_deadline_disabled_never_fires(tmp_path):
+    cfg = _mk_cfg(tmp_path, deadline_s=0.0)
+    store = DatasetStore(cfg)
+    store.create("free")
+    jm = JobManager(store, cfg=cfg)
+    rec = jm.submit("ingest", "free", lambda: time.sleep(0.3))
+    jm.wait_all(timeout=30)
+    assert rec.status == "done"
+    assert not jm._watchdog_started      # no deadline → no thread at all
+
+
+def test_pre_heartbeat_failpoint_slow_mode_trips_watchdog(tmp_path):
+    """The declared ``job.pre_heartbeat`` site in ``slow`` mode: a wedge
+    AT a progress boundary (the mark never lands) is exactly what the
+    watchdog must catch — bounded by SLOW_S, not an unbounded hang."""
+    cfg = _mk_cfg(tmp_path, deadline_s=0.4)
+    store = DatasetStore(cfg)
+    store.create("fp")
+    jm = JobManager(store, cfg=cfg)
+    failpoints.configure("job.pre_heartbeat=slow")
+
+    def body():
+        from learningorchestra_tpu import jobs
+
+        jobs.heartbeat()      # stalls SLOW_S (2 s) ≫ the 0.4 s deadline
+
+    rec = jm.submit("ingest", "fp", body)
+    deadline = time.time() + 10
+    while rec.status == "running" and time.time() < deadline:
+        time.sleep(0.05)
+    assert rec.status == "failed"
+    assert rec.error.startswith("interrupted: watchdog")
+    jm.wait_all(timeout=30)              # body wakes from SLOW_S cleanly
+
+
+def test_job_watchdog_alert_fires_on_counter_delta():
+    from learningorchestra_tpu.utils import alerts
+
+    cfg = Settings()
+    engine = alerts.AlertEngine(alerts.default_rules(cfg), window_s=0.0,
+                                for_windows=2, clear_windows=2)
+    base = {"job_fault": {"watchdog_fired_total": 3,
+                          "jobs_resumed_total": 0}}
+    assert engine.evaluate(base) == []            # baseline, no re-page
+    assert engine.evaluate(base) == []            # no delta
+    bumped = {"job_fault": {"watchdog_fired_total": 4,
+                            "jobs_resumed_total": 1}}
+    fired = engine.evaluate(bumped)
+    assert [t["alert"] for t in fired] == ["job_watchdog_fired"]
+    assert "job_watchdog_fired" in engine.firing(severity="critical")
+
+
+def test_client_raises_typed_job_deadline_expired():
+    from learningorchestra_tpu.client import (
+        AsyncronousWait, Context, JobDeadlineExpired, JobFailed)
+    from learningorchestra_tpu.serving.http import Router, Server
+
+    router = Router()
+
+    @router.route("GET", "/files/{name}")
+    def read_file(req):
+        if req.params["name"] == "hung":
+            return 200, [{"filename": "hung", "finished": True,
+                          "error": "interrupted: watchdog: job x hung",
+                          "retries": 2}]
+        return 200, [{"filename": req.params["name"], "finished": True,
+                      "error": "ValueError: bad label"}]
+
+    srv = Server(router, "127.0.0.1", 0).start_background()
+    try:
+        waiter = AsyncronousWait(Context(f"http://127.0.0.1:{srv.port}",
+                                         timeout=10))
+        with pytest.raises(JobDeadlineExpired, match="retries=2"):
+            waiter.wait("hung")
+        # a deterministic input error stays the base JobFailed type
+        with pytest.raises(JobFailed) as exc:
+            waiter.wait("plain")
+        assert not isinstance(exc.value, JobDeadlineExpired)
+    finally:
+        srv.stop()
+
+
+# -- slow lane: supervised end-to-end recovery --------------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(extra):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LO_TPU_MESH_EPOCH",
+                        "LO_TPU_RESTART_COUNT", "LO_TPU_FAILPOINTS")}
+    env.update(extra)
+    return env
+
+
+def _run_supervised(tmp_path, *, failpoint_spec, deadline_s,
+                    health_url=False, wall_s=240):
+    """Run the child under a real Supervisor until its gb build reaches
+    a clean terminal state with retries=1; returns (metadata doc, jobs
+    doc fetched from the recovered incarnation, supervisor)."""
+    from learningorchestra_tpu.supervisor import Supervisor
+
+    port = _free_port()
+    cfg = Settings()
+    cfg.restart_budget = 3
+    cfg.restart_backoff_s = 0.2
+    cfg.restart_backoff_max_s = 1.0
+    cfg.health_interval_s = 0.5
+    sup = Supervisor(
+        [[sys.executable, CHILD, str(tmp_path), str(port),
+          str(deadline_s)]],
+        cfg=cfg,
+        env=_child_env({"LO_TPU_FAILPOINTS": failpoint_spec}),
+        health_url=(f"http://127.0.0.1:{port}/cluster"
+                    if health_url else None))
+    runner = threading.Thread(target=sup.run, name="jf-sup-run",
+                              daemon=True)
+    runner.start()
+    try:
+        meta_path = tmp_path / "store" / "j_pred_gb" / "metadata.json"
+        deadline = time.time() + wall_s
+        doc = None
+        while time.time() < deadline:
+            if meta_path.is_file():
+                got = json.loads(meta_path.read_text() or "{}")
+                if got.get("finished") and not got.get("error") \
+                        and got.get("retries"):
+                    doc = got
+                    break
+            time.sleep(0.5)
+        assert doc is not None, (
+            "retried job never reached a clean terminal state "
+            f"(supervisor: restarts={sup.restarts}, epoch={sup.epoch}, "
+            f"failure={sup.failure})")
+        jobs_doc = requests.get(f"http://127.0.0.1:{port}/jobs",
+                                timeout=10).json()
+        return doc, jobs_doc, sup
+    finally:
+        sup.close()
+        runner.join(timeout=20)
+
+
+@pytest.mark.slow
+def test_supervised_crash_mid_gb_fit_resumes_from_checkpoint(tmp_path):
+    """The SIGKILL-mid-fit loop: the child dies (os._exit) at its THIRD
+    checkpoint commit — rounds 1-2 durable — the supervisor restarts it,
+    the rescan re-runs the build, and the retried fit RESUMES: its
+    profile proves it re-executed fewer rounds than the total."""
+    doc, jobs_doc, sup = _run_supervised(
+        tmp_path, failpoint_spec="fit.ckpt.pre_rename=crash:3",
+        deadline_s=0.0)
+    assert doc["retries"] == 1, doc
+    assert sup.restarts == 1, sup.failure
+    done = [j for j in jobs_doc
+            if j["kind"].endswith("model_builder")
+            and j["status"] == "done"]
+    assert done, jobs_doc
+    resumed = (done[0].get("profile") or {}).get("resumed_from", {})
+    assert resumed.get("gb", {}).get("rounds") == 2, resumed
+    assert resumed["gb"]["of"] == 8
+    # genuinely good fit, not merely terminal
+    assert doc.get("f1", 1.0) > 0.8, doc
+
+
+@pytest.mark.slow
+def test_supervised_hang_watchdog_bounded_recovery(tmp_path):
+    """The hung-device-program loop (the acceptance e2e): a real ``hang``
+    armed at the first progress mark wedges the build job; within the
+    liveness deadline (45 s — comfortably above one segment's compile
+    time, so only a genuine wedge trips it) the watchdog fails it
+    retryably and poisons the pod, the supervisor's health poll restarts
+    it under a new epoch, and the retried job completes — with the
+    flight-recorder bundle naming the watchdog as the cause. Bounded end
+    to end: the hung thread dies with its process, never with the test
+    suite."""
+    t0 = time.time()
+    doc, jobs_doc, sup = _run_supervised(
+        tmp_path, failpoint_spec="job.pre_heartbeat=hang",
+        deadline_s=45.0, health_url=True, wall_s=300)
+    assert doc["retries"] == 1, doc
+    assert sup.restarts == 1, sup.failure
+    assert sup.epoch == 1
+    # evidence bundle from the killed incarnation survives on disk
+    frec = tmp_path / "store" / "_flightrec"
+    reasons = []
+    for bundle in sorted(os.listdir(frec)):
+        with open(frec / bundle / "manifest.json") as f:
+            reasons.append(json.load(f)["reason"])
+    assert "job:watchdog" in reasons, reasons
+    # bounded MTTR: far under the 3600 s the naked hang would cost
+    assert time.time() - t0 < 290
+
+
+def test_watchdog_poison_scopes_to_the_epoch(tmp_path, monkeypatch):
+    """The PR 2 contract holds for watchdog poison too: the restarted
+    incarnation (next mesh epoch) reads healthy with no manual
+    clearing."""
+    monkeypatch.setenv("LO_TPU_MESH_EPOCH", "0")
+    spmd.poison_pod("watchdog: job x hung past its 1.0s deadline")
+    assert "watchdog" in spmd.pod_error()
+    monkeypatch.setenv("LO_TPU_MESH_EPOCH", "1")
+    assert spmd.pod_error() is None

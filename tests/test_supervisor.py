@@ -276,6 +276,63 @@ def test_epoch_file_owner_publishes_and_follower_follows(tmp_path):
         t.join(timeout=10)
 
 
+# -- restart-budget decay (LO_TPU_RESTART_HEALTHY_S) --------------------------
+
+def test_restart_budget_decays_after_healthy_window(tmp_path):
+    """One blip consumes budget; after a continuous healthy window the
+    consumed count resets to zero — an incident from long ago no longer
+    dooms the next one (exhaustion used to be permanent)."""
+    import threading
+
+    flag = str(tmp_path / "blipped")
+    code = ("import os,sys,time; p=%r; "
+            "(open(p,'w').close(), sys.exit(7)) "
+            "if not os.path.exists(p) else time.sleep(60)") % flag
+    cfg = Settings()
+    cfg.restart_budget = 1
+    cfg.restart_backoff_s = 0.05
+    cfg.restart_healthy_s = 0.4
+    sup = _fast(Supervisor([[sys.executable, "-c", code]], cfg=cfg))
+    # thread-lifecycle is a package rule; test thread joined below.
+    t = threading.Thread(target=sup.run, name="sup-decay", daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 15
+        while sup.restarts != 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert sup.restarts == 1        # the blip spent the whole budget
+        # the child now stays up: past the healthy window the budget is
+        # restored, so tonight's NEXT blip would restart, not exhaust
+        deadline = time.time() + 15
+        while sup.restarts != 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert sup.restarts == 0, "healthy uptime never restored budget"
+        assert sup.failure is None
+    finally:
+        sup.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def test_flapping_pod_still_exhausts_budget_despite_decay():
+    """A pod failing faster than the healthy window never accrues the
+    continuous uptime decay requires: the budget exhausts exactly as
+    before (decay forgives recovered pods, not flapping ones)."""
+    cfg = Settings()
+    cfg.restart_budget = 2
+    cfg.restart_backoff_s = 0.05
+    cfg.restart_backoff_max_s = 0.2
+    cfg.restart_healthy_s = 0.4         # decay enabled — and irrelevant
+    sup = _fast(Supervisor(
+        [[sys.executable, "-c", "import sys; sys.exit(7)"]], cfg=cfg))
+    try:
+        assert sup.run() == 1
+        assert sup.restarts == 3
+        assert "restart budget exhausted" in sup.failure
+    finally:
+        sup.close()
+
+
 # -- failed-job rescan/retry selection ---------------------------------------
 
 def _doc(name, error=None, finished=True, job=None, retries=0):
