@@ -171,6 +171,27 @@ class Settings:
     image_root: str = field(
         default_factory=lambda: _env("LO_TPU_IMAGE_ROOT", "/tmp/lo_tpu_images")
     )
+    #: HTTP accept processes. ``1`` (the default) keeps today's
+    #: single-process topology byte-for-byte: the device-owning process
+    #: serves HTTP itself through the threaded stdlib server. ``N > 1``
+    #: binds N lightweight front-end worker processes to the SAME
+    #: host:port via ``SO_REUSEPORT`` (the kernel spreads accepted
+    #: connections across them, sidestepping the GIL), each running an
+    #: async ``selectors`` request loop and forwarding predict rows /
+    #: proxied requests to the device-owning process over the
+    #: length-prefixed row channel (serving/rowchannel.py,
+    #: serving/frontend.py — docs/serving.md §front end).
+    http_workers: int = field(
+        default_factory=lambda: _env("LO_TPU_HTTP_WORKERS", 1)
+    )
+    #: Handler threads the device-owning process runs for row-channel
+    #: frames from front-end workers — bounds how many forwarded
+    #: requests execute concurrently inside the primary (the analogue
+    #: of the threaded server's one-thread-per-connection, made
+    #: explicit). Only meaningful when ``http_workers > 1``.
+    frontend_channel_threads: int = field(
+        default_factory=lambda: _env("LO_TPU_FRONTEND_CHANNEL_THREADS", 16)
+    )
 
     # --- online inference (serving/batcher.py, models/aot.py) --------------
     #: Largest coalesced micro-batch (rows) per device dispatch of the

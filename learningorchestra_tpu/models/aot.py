@@ -109,9 +109,24 @@ def design_from_rows(rows: Sequence[Any], pp: Dict[str, Any]) -> np.ndarray:
     - list of lists — already-assembled design rows in
       ``feature_fields`` order (the zero-copy fast path for callers that
       preprocess client-side).
+    - a 2-D ``np.ndarray`` — rows already decoded from a binary columnar
+      request body (serving/rowchannel.py): same width/finiteness
+      validation as list rows with ZERO per-row decode — the buffer the
+      socket delivered is the design matrix.
     """
     from learningorchestra_tpu.ops.preprocess import apply_steps
 
+    if isinstance(rows, np.ndarray):
+        feature_fields = list(pp["feature_fields"])
+        if rows.ndim != 2 or rows.shape[0] == 0:
+            raise ValueError(
+                "columnar rows must be a non-empty 2-D matrix")
+        if rows.shape[1] != len(feature_fields):
+            raise ValueError(
+                f"columnar rows must be shaped (n, {len(feature_fields)}) "
+                f"matching feature_fields {feature_fields}")
+        X = np.asarray(rows, dtype=np.float32)
+        return _finite_design(np.ascontiguousarray(X), feature_fields)
     if not isinstance(rows, (list, tuple)) or not rows:
         raise ValueError("rows must be a non-empty JSON array")
     feature_fields = list(pp["feature_fields"])

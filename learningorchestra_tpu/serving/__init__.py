@@ -1,2 +1,18 @@
-from learningorchestra_tpu.serving.app import App  # noqa: F401
-from learningorchestra_tpu.serving.http import Server  # noqa: F401
+"""Serving package. ``App`` and ``Server`` are lazy attributes (PEP 562)
+rather than eager imports: front-end worker processes (serving/
+frontend.py) import sibling modules from this package and must NOT pull
+``app``'s transitive jax/device stack into every accept process — the
+whole point of the worker split is that only the batcher process owns
+the device."""
+
+
+def __getattr__(name):
+    if name == "App":
+        from learningorchestra_tpu.serving.app import App
+
+        return App
+    if name == "Server":
+        from learningorchestra_tpu.serving.http import Server
+
+        return Server
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

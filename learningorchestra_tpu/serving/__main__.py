@@ -117,8 +117,10 @@ def main() -> None:
 
     spmd.ensure_channel()  # workers connect at boot; listener must exist
     app = App(settings, recover=not args.no_recover)
-    log.info("learningorchestra_tpu serving on %s:%d (devices: %s)",
-             args.host, args.port, distributed.process_info()["devices"])
+    log.info("learningorchestra_tpu serving on %s:%d (devices: %s, "
+             "http workers: %d)", args.host, args.port,
+             distributed.process_info()["devices"],
+             max(1, settings.http_workers))
     server = app.serve(background=True)
     stopped = install_graceful_shutdown(app, server)
     try:

@@ -121,6 +121,11 @@ class App:
         #: in-flight predicts and queued jobs run to completion —
         #: a planned restart loses zero accepted requests.
         self._draining = threading.Event()
+        #: The multi-worker front end when ``LO_TPU_HTTP_WORKERS > 1``
+        #: (serving/frontend.py FrontendServer), set by :meth:`serve` —
+        #: its worker/channel counters feed ``/metrics`` (``frontend``
+        #: section → ``lo_frontend_*``) and the health rollup.
+        self._frontend = None
         self.router = Router()
         self._register()
         if recover and self.cfg.persist:
@@ -132,6 +137,102 @@ class App:
             self._rescan_failed_jobs()
 
     # -- helpers -------------------------------------------------------------
+
+    def drain_error(self) -> HttpError:
+        """The draining 503: Retry-After sized to the drain window,
+        ``Connection: close`` so the keep-alive socket is shed and the
+        client's retry lands on a healthy peer instead of this exiting
+        process. One constructor — the threaded drain gate and the
+        row-channel predict path answer identically."""
+        return HttpError(
+            503, "server draining for shutdown; retry elsewhere",
+            headers={"Retry-After": str(max(
+                1, math.ceil(self.cfg.drain_timeout_s))),
+                "Connection": "close"})
+
+    def map_exception(self, e: Exception) -> Optional[HttpError]:
+        """Domain exception → the reference's status codes — THE one
+        mapping, shared by the threaded handler stack (``_wrap``) and
+        the multi-worker row-channel path (serving/frontend.py), so the
+        process hop can never answer a different status than the
+        single-process oracle. Returns None for exceptions the serving
+        layer does not own (the caller re-raises → 500 boundary)."""
+        try:
+            raise e
+        except HttpError as he:
+            return he
+        except QueueFull as qe:
+            # Predict queue at capacity: backpressure, not failure.
+            # Retry-After + 503 is the contract the client's jittered
+            # backoff already honors (PR 2/PR 4); the hint is COMPUTED
+            # from predicted queue wait (depth × recent per-row service
+            # rate, serving/batcher.py) — when to come back, not a
+            # constant.
+            return HttpError(
+                503, str(qe),
+                headers={"Retry-After":
+                         str(max(1, math.ceil(qe.retry_after_s)))})
+        except DeadlineExceeded as de:
+            # The caller's end-to-end budget is unmeetable or already
+            # spent: a TERMINAL 504 — distinct from the retryable 503
+            # family on purpose (the client never retries it;
+            # re-sending abandoned work only deepens overload). No
+            # Retry-After: there is nothing to wait for, the budget
+            # belonged to the caller.
+            return HttpError(504, str(de))
+        except ModelQuarantined as me:
+            # Terminal until an operator (or a re-save) lifts it — a
+            # long Retry-After so stock clients' bounded backoff gives
+            # up fast instead of hammering a dead model.
+            return HttpError(
+                503, str(me),
+                headers={"Retry-After": str(max(
+                    1, math.ceil(self.cfg.restart_backoff_max_s)))})
+        except DispatcherCrashed as ce:
+            # The dispatcher crashed after this request's batch hit the
+            # device; the supervised restart is already under way —
+            # hint its first backoff step.
+            return HttpError(
+                503, str(ce),
+                headers={"Retry-After": str(max(
+                    1, math.ceil(self.cfg.serve_restart_backoff_s)))})
+        except PredictTimeout as te:
+            return HttpError(503, str(te), headers={"Retry-After": "5"})
+        except BatcherStopped as se:
+            # A request raced the model's dispatcher teardown (DELETE
+            # or shutdown): transient — the retry gets the terminal
+            # answer (404 if deleted, a fresh dispatcher otherwise).
+            return HttpError(503, str(se), headers={"Retry-After": "1"})
+        except ChunkCorrupt as xe:
+            # Integrity failure the replica couldn't heal: a precise
+            # 500 naming the chunk/checksums, not a parse traceback.
+            return HttpError(500, str(xe))
+        except spmd.PodDegraded as pe:
+            # A degraded pod is mid-recovery (its supervisor restarts
+            # it under a new mesh epoch): answer 503 + Retry-After
+            # COMPUTED from the recovery machinery's own knobs — the
+            # supervisor needs a health-poll interval to notice plus
+            # its first restart backoff — instead of a hard-coded
+            # constant.
+            return HttpError(
+                503, str(pe),
+                headers={"Retry-After": str(max(1, math.ceil(
+                    self.cfg.health_interval_s
+                    + self.cfg.restart_backoff_s)))})
+        except DatasetNotFound as ne:
+            return HttpError(404, f"dataset not found: {ne}")
+        except ImageNotFound as ie:
+            return HttpError(404, f"image not found: {ie}")
+        except (DatasetExists, ImageExists) as ee:
+            return HttpError(409, f"duplicate: {ee}")
+        except KeyError as ke:
+            return HttpError(404, str(ke))
+        except PermissionError as pr:
+            return HttpError(403, str(pr))
+        except ValueError as ve:
+            return HttpError(406, str(ve))
+        except Exception:  # noqa: BLE001 — not serving-owned: 500 boundary
+            return None
 
     def _wrap(self, fn, replay_posts: bool = True):
         """Translate domain exceptions to the reference's status codes.
@@ -150,86 +251,17 @@ class App:
                     self._draining.is_set():
                 # Draining: no NEW work — in-flight requests finish,
                 # reads keep serving (operators watch the drain through
-                # them). Connection: close sheds the keep-alive socket
-                # so the client's retry lands on a healthy peer instead
-                # of this exiting process.
-                raise HttpError(
-                    503, "server draining for shutdown; retry elsewhere",
-                    headers={"Retry-After": str(max(
-                        1, math.ceil(self.cfg.drain_timeout_s))),
-                        "Connection": "close"})
+                # them).
+                raise self.drain_error()
             try:
                 return fn(req)
-            except QueueFull as e:
-                # Predict queue at capacity: backpressure, not failure.
-                # Retry-After + 503 is the contract the client's
-                # jittered backoff already honors (PR 2/PR 4); the hint
-                # is COMPUTED from predicted queue wait (depth × recent
-                # per-row service rate, serving/batcher.py) — when to
-                # come back, not a constant.
-                raise HttpError(
-                    503, str(e),
-                    headers={"Retry-After":
-                             str(max(1, math.ceil(e.retry_after_s)))})
-            except DeadlineExceeded as e:
-                # The caller's end-to-end budget is unmeetable or
-                # already spent: a TERMINAL 504 — distinct from the
-                # retryable 503 family on purpose (the client never
-                # retries it; re-sending abandoned work only deepens
-                # overload). No Retry-After: there is nothing to wait
-                # for, the budget belonged to the caller.
-                raise HttpError(504, str(e))
-            except ModelQuarantined as e:
-                # Terminal until an operator (or a re-save) lifts it —
-                # a long Retry-After so stock clients' bounded backoff
-                # gives up fast instead of hammering a dead model.
-                raise HttpError(
-                    503, str(e),
-                    headers={"Retry-After": str(max(
-                        1, math.ceil(self.cfg.restart_backoff_max_s)))})
-            except DispatcherCrashed as e:
-                # The dispatcher crashed after this request's batch hit
-                # the device; the supervised restart is already under
-                # way — hint its first backoff step.
-                raise HttpError(
-                    503, str(e),
-                    headers={"Retry-After": str(max(
-                        1, math.ceil(self.cfg.serve_restart_backoff_s)))})
-            except PredictTimeout as e:
-                raise HttpError(503, str(e), headers={"Retry-After": "5"})
-            except BatcherStopped as e:
-                # A request raced the model's dispatcher teardown (DELETE
-                # or shutdown): transient — the retry gets the terminal
-                # answer (404 if deleted, a fresh dispatcher otherwise).
-                raise HttpError(503, str(e), headers={"Retry-After": "1"})
-            except ChunkCorrupt as e:
-                # Integrity failure the replica couldn't heal: a precise
-                # 500 naming the chunk/checksums, not a parse traceback.
-                raise HttpError(500, str(e))
-            except spmd.PodDegraded as e:
-                # A degraded pod is mid-recovery (its supervisor restarts
-                # it under a new mesh epoch): answer 503 + Retry-After
-                # COMPUTED from the recovery machinery's own knobs — the
-                # supervisor needs a health-poll interval to notice plus
-                # its first restart backoff — instead of a hard-coded
-                # constant.
-                raise HttpError(
-                    503, str(e),
-                    headers={"Retry-After": str(max(1, math.ceil(
-                        self.cfg.health_interval_s
-                        + self.cfg.restart_backoff_s)))})
-            except DatasetNotFound as e:
-                raise HttpError(404, f"dataset not found: {e}")
-            except ImageNotFound as e:
-                raise HttpError(404, f"image not found: {e}")
-            except (DatasetExists, ImageExists) as e:
-                raise HttpError(409, f"duplicate: {e}")
-            except KeyError as e:
-                raise HttpError(404, str(e))
-            except PermissionError as e:
-                raise HttpError(403, str(e))
-            except ValueError as e:
-                raise HttpError(406, str(e))
+            except HttpError:
+                raise
+            except Exception as e:  # noqa: BLE001 — mapped or re-raised
+                mapped = self.map_exception(e)
+                if mapped is None:
+                    raise
+                raise mapped from e
 
         def inner(req):
             if req.method == "POST" and replay_posts:
@@ -725,6 +757,11 @@ class App:
                "pod": {"error": pod_error,
                        "degraded": pod_error is not None},
                "profile_dir": self.cfg.profile_dir or None}
+        if self._frontend is not None:
+            # Multi-worker topology only: accept-process liveness,
+            # respawn accounting and row-channel frame counters
+            # (rendered as lo_frontend_* on the exposition surface).
+            doc["frontend"] = self._frontend.snapshot()
         # History BEFORE alert evaluation: the burn-rate rules read the
         # store, so the sample that triggered this read must be in it.
         self.history.observe(doc)
@@ -770,6 +807,18 @@ class App:
             "alerts": {"ok": not critical, "firing": firing,
                        "critical": critical},
         }
+        if self._frontend is not None:
+            # At least one accept process must be alive for the port to
+            # answer at all; a respawn window (some dead, some alive)
+            # degrades capacity, not health — the kernel routes around
+            # dead listeners and the supervisor is already respawning.
+            fr = mdoc.get("frontend") or {}
+            checks["frontend"] = {
+                "ok": (fr.get("workers_alive") or 0) > 0,
+                "workers": fr.get("workers"),
+                "workers_alive": fr.get("workers_alive"),
+                "slots_abandoned": fr.get("slots_abandoned"),
+            }
         return {"healthy": all(c["ok"] for c in checks.values()),
                 "state": "draining" if draining else "serving",
                 "checks": checks,
@@ -947,9 +996,26 @@ class App:
         self.predictor.stop()
         return quiesced
 
-    def serve(self, background: bool = False) -> Server:
-        server = Server(self.router, self.cfg.host, self.cfg.port,
-                        request_timeout_s=self.cfg.http_timeout_s)
+    def serve(self, background: bool = False):
+        if int(self.cfg.http_workers) > 1:
+            # Multi-worker front end (ROADMAP item 1): N SO_REUSEPORT
+            # accept processes own the HTTP sockets, THIS process owns
+            # the device and every serving semantic, and the two meet
+            # on the row channel (serving/frontend.py). Same start/
+            # stop/port surface as the threaded Server, so callers
+            # cannot tell the topologies apart.
+            from learningorchestra_tpu.serving.frontend import (
+                FrontendServer)
+
+            server = FrontendServer(self, self.cfg.host, self.cfg.port)
+            self._frontend = server
+        else:
+            # LO_TPU_HTTP_WORKERS unset/1: today's single-process
+            # topology, byte-for-byte — the oracle the multi-worker
+            # path is tested against.
+            server = Server(self.router, self.cfg.host, self.cfg.port,
+                            request_timeout_s=self.cfg.http_timeout_s)
+            self._frontend = None
         # Stopping the server stops the predict dispatcher threads too
         # (queued requests fail fast instead of waiting out their
         # timeout against a dead worker).

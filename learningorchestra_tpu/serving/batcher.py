@@ -862,6 +862,29 @@ class PredictBatcher:
         clock starts HERE (so design-build time counts against it), and
         expiry anywhere downstream raises :class:`DeadlineExceeded`
         (→ terminal 504)."""
+        kind, probs = self.predict_probs(name, rows, deadline_ms)
+        # .tolist() (C-speed) — this runs per request on the hot path.
+        return {
+            "model": name,
+            "kind": kind,
+            "predictions": np.argmax(probs, axis=1).tolist(),
+            # tolist() on float32 already widens to exact Python floats
+            # — an astype(float64) first would copy for identical JSON.
+            "probabilities": probs.tolist(),
+        }
+
+    def predict_probs(self, name: str, rows: Sequence[Any],
+                      deadline_ms: Optional[float] = None
+                      ) -> Tuple[str, np.ndarray]:
+        """The raw form of :meth:`predict`: ``(model kind, float32
+        probability matrix)`` with NO response formatting — what the
+        multi-worker front end's row channel calls, so the JSON encode
+        of a forwarded request happens in the worker process (off this
+        process's GIL) while the numbers stay bit-identical (the worker
+        runs the same argmax/tolist on the same float32 bytes).
+        Accounting, deadlines, backpressure and drain quiescing are
+        identical by construction: :meth:`predict` is this plus
+        formatting."""
         with self._lock:
             self._active += 1
         try:
@@ -871,7 +894,7 @@ class PredictBatcher:
                 self._active -= 1
 
     def _predict(self, name: str, rows: Sequence[Any],
-                 deadline_ms: Optional[float]) -> Dict[str, Any]:
+                 deadline_ms: Optional[float]) -> Tuple[str, np.ndarray]:
         deadline = budget_ms = None
         if deadline_ms is not None:
             if deadline_ms <= 0:
@@ -924,8 +947,10 @@ class PredictBatcher:
         entry = self.aot.entry(name)
         # Shape-check the body before len()/preprocessing: {"rows":
         # null} or a scalar must 406 like every other malformed input,
-        # not 500 on a TypeError.
-        if not isinstance(rows, (list, tuple)):
+        # not 500 on a TypeError. An ndarray means a binary columnar
+        # body already decoded (serving/rowchannel.py) — design rows
+        # with zero per-row parse left to do.
+        if not isinstance(rows, (list, tuple, np.ndarray)):
             raise ValueError(
                 "rows must be a non-empty JSON array of feature rows")
         # Cap check BEFORE preprocessing: the client's cap-discovery
@@ -951,15 +976,7 @@ class PredictBatcher:
                             attrs={"model": name, "rows": len(rows)})
         probs = self._batcher(name).submit(X, entry, deadline=deadline,
                                            budget_ms=budget_ms)
-        # .tolist() (C-speed) — this runs per request on the hot path.
-        return {
-            "model": name,
-            "kind": entry.kind,
-            "predictions": np.argmax(probs, axis=1).tolist(),
-            # tolist() on float32 already widens to exact Python floats
-            # — an astype(float64) first would copy for identical JSON.
-            "probabilities": probs.tolist(),
-        }
+        return entry.kind, probs
 
     def invalidate(self, name: Optional[str] = None) -> None:
         """Drop compiled programs (and the dispatcher thread) for a

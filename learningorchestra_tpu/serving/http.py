@@ -35,6 +35,32 @@ _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 FP_PRE_RESPONSE = failpoints.declare("serving.http.pre_response")
 
 
+def parse_body(raw: Optional[bytes], content_type: str) -> Optional[Dict]:
+    """Request body bytes → handler body dict — THE body parse, shared
+    by the threaded handler and the row-channel proxy path so a body
+    parses identically whichever topology served it.
+
+    JSON is the default; a binary columnar body
+    (``application/x-lo-columnar``) decodes to ``{"rows": <float32
+    matrix>}`` — the zero-copy predict fast path — and malformation maps
+    to the same 406 a malformed JSON row gets, never a 500."""
+    if not raw:
+        return None
+    base = (content_type or "").split(";", 1)[0].strip().lower()
+    if base == "application/x-lo-columnar":
+        from learningorchestra_tpu.serving.rowchannel import (
+            decode_columnar)
+
+        try:
+            return {"rows": decode_columnar(raw)}
+        except ValueError as e:
+            raise HttpError(406, str(e)) from None
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        raise HttpError(400, "invalid JSON body") from None
+
+
 class HttpError(Exception):
     def __init__(self, status: int, message: str,
                  headers: Optional[Dict[str, str]] = None):
@@ -233,10 +259,11 @@ def _make_handler(router: Router, request_timeout_s: Optional[float] = None):
             if not length:
                 return None
             raw = self.rfile.read(length)
-            try:
-                return json.loads(raw)
-            except json.JSONDecodeError:
-                raise HttpError(400, "invalid JSON body")
+            # Shared parse (JSON or binary columnar) — identical to the
+            # multi-worker proxy path's, so a client needn't know the
+            # server's topology to pick a body format.
+            return parse_body(raw,
+                              self.headers.get("Content-Type") or "")
 
         def _send_bytes(self, status: int, content_type: str,
                         data: bytes,

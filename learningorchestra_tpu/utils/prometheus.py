@@ -213,6 +213,15 @@ def render(doc: Dict[str, Any]) -> str:
         _flat_counters(w, "lo_serving_aot", aot, _COUNTER,
                        "AOT predict-program cache counter")
 
+    frontend = doc.get("frontend") or {}
+    if frontend:
+        # Multi-worker front end (LO_TPU_HTTP_WORKERS > 1): accept-
+        # process liveness + respawns and row-channel frame counters.
+        # Gauge is the honest common type — live worker counts sit next
+        # to monotone frame totals.
+        _flat_counters(w, "lo_frontend", frontend, _GAUGE,
+                       "Multi-worker serving front end metric")
+
     res = doc.get("resources") or {}
     host = res.get("host") or {}
     if host:

@@ -406,13 +406,22 @@ def record_span(name: str, duration_s: float, *,
     instrumentation points that already time themselves (``device_span``,
     the batcher's queue-wait bookkeeping) emit spans that agree with
     their metrics to the digit. Returns the span id, or None when the
-    (explicit or ambient) context is absent/unsampled."""
+    (explicit or ambient) context is absent/unsampled.
+
+    ``parent_id=""`` records a ROOT span (parent None) — how the
+    front-end worker's event loop emits its ``http.handle`` root after
+    the fact (an async request has no enclosing ``with trace(...)``
+    frame to root it)."""
     c = ctx if ctx is not None else _ctx.get()
     if c is None or not c.sampled:
         return None
     sid = span_id or new_id()
+    pid: Optional[str] = (parent_id if parent_id is not None
+                          else c.span_id)
+    if pid == "":
+        pid = None
     _record(Span(c.trace_id, sid,
-                 parent_id if parent_id is not None else c.span_id,
+                 pid,
                  name,
                  t_wall if t_wall is not None else time.time() - duration_s,
                  duration_s, attrs, status, error))

@@ -1,0 +1,493 @@
+"""Multi-worker serving front end (serving/frontend.py, rowchannel.py).
+
+The load-bearing guarantees under test:
+
+- **oracle parity**: with ``LO_TPU_HTTP_WORKERS=2`` (SO_REUSEPORT accept
+  processes + row channel), every predict response — JSON body or binary
+  columnar body — is BIT-identical to the single-process path's, for
+  every online family (lr/nb/dt/rf/gb/mlp);
+- **malformed binary body → 406**, never a 500, on both topologies;
+- **cross-process tracing**: one trace id spans the worker process (the
+  ``http.handle`` root) and the device process (``queue.wait`` /
+  ``dispatch.device``) with correct parent links;
+- **semantics across the hop**: backpressure (503 + computed
+  Retry-After), deadlines (X-Deadline-Ms → terminal 504), drain (503 +
+  Connection: close from every worker, /healthz ``draining``, zero
+  accepted-request loss);
+- **chaos**: the new ``serving.front.pre_forward`` / ``pre_reply``
+  seams — raise-mode yields a retryable 503 (never a hang) and crash
+  mode (a worker process dying mid-request) is survived by kernel
+  re-routing + supervisor respawn, with the stock client completing;
+- ``LO_TPU_HTTP_WORKERS`` unset/1 keeps today's in-process topology
+  (the threaded ``Server``) — the oracle stays byte-for-byte.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from learningorchestra_tpu.client import Context, DeadlineExpired, Model
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.models.registry import ONLINE_KINDS
+from learningorchestra_tpu.serving import rowchannel
+from learningorchestra_tpu.serving.frontend import (
+    FrontendServer, WORKER_PROCESS_BASE)
+from learningorchestra_tpu.serving.http import Server
+
+FAMILIES = list(ONLINE_KINDS)
+
+ROW = [0.5, -0.2, 1.1, 0.3]
+
+
+def _make_cfg(tmp, workers=2, **kw):
+    cfg = Settings()
+    cfg.store_root = str(tmp / "store")
+    cfg.image_root = str(tmp / "images")
+    cfg.port = 0
+    cfg.persist = False
+    cfg.serve_max_batch = 64
+    cfg.http_workers = workers
+    cfg.restart_backoff_s = 0.05
+    cfg.restart_backoff_max_s = 0.5
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _build_app(cfg, families):
+    from learningorchestra_tpu.serving.app import App
+
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(0)
+    n = 260
+    y = rng.integers(0, 2, n)
+    centers = rng.normal(size=(2, 4)) * 2.0
+    X = (centers[y] + rng.normal(size=(n, 4))).astype(np.float64)
+    ds = app.store.create("fe_train")
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = y.astype(np.int64)
+    ds.append_columns(cols)
+    app.store.finish("fe_train")
+    app.builder.build("fe_train", "fe_train", "fe", families, "y")
+    return app
+
+
+@pytest.fixture(scope="module")
+def frontend(tmp_path_factory):
+    """Live 2-worker front end over one model per online family, plus a
+    single-process oracle server over the SAME store — responses from
+    the two topologies can be compared byte for byte."""
+    tmp = tmp_path_factory.mktemp("frontend")
+    cfg = _make_cfg(tmp, workers=2)
+    app = _build_app(cfg, FAMILIES)
+    server = app.serve(background=True)
+    assert isinstance(server, FrontendServer)
+    # The single-process oracle: the SAME app served by the threaded
+    # stack on another port (what LO_TPU_HTTP_WORKERS=1 runs).
+    oracle = Server(app.router, "127.0.0.1", 0,
+                    request_timeout_s=cfg.http_timeout_s)
+    oracle.start_background()
+    ctx = Context(f"http://127.0.0.1:{server.port}", poll_seconds=0.1,
+                  timeout=60)
+    # Warm every AOT ladder outside the timed/asserted sections.
+    for kind in FAMILIES:
+        app.predictor.predict(f"fe_{kind}", [ROW])
+    yield ctx, app, server, f"http://127.0.0.1:{oracle.port}"
+    oracle.stop()
+    server.stop()
+
+
+def test_default_topology_is_single_process(tmp_path):
+    """LO_TPU_HTTP_WORKERS unset/1 serves through the threaded stdlib
+    Server exactly as before — the multi-worker path only engages when
+    explicitly asked for."""
+    assert Settings().http_workers == 1
+    from learningorchestra_tpu.serving.app import App
+
+    cfg = _make_cfg(tmp_path, workers=1)
+    app = App(cfg, recover=False)
+    server = app.serve(background=True)
+    try:
+        assert isinstance(server, Server)
+        assert not isinstance(server, FrontendServer)
+    finally:
+        server.stop()
+
+
+def test_columnar_codec_roundtrip():
+    X = np.arange(12, dtype=np.float32).reshape(3, 4)
+    body = rowchannel.encode_columnar(X)
+    np.testing.assert_array_equal(rowchannel.decode_columnar(body), X)
+    for bad in (b"", b"XXXX", body[:-1], body + b"\x00",
+                b"LOCB" + b"\x00" * 12):
+        with pytest.raises(ValueError):
+            rowchannel.decode_columnar(bad)
+
+
+@pytest.mark.parametrize("kind", FAMILIES)
+def test_binary_body_parity_all_families(frontend, kind):
+    """JSON body vs binary columnar body vs the single-process oracle:
+    all three answer BIT-identical bytes for every online family."""
+    ctx, app, server, oracle_base = frontend
+    name = f"fe_{kind}"
+    rows = [[0.1 * i, -0.2, 1.0 + 0.05 * i, 0.3] for i in range(9)]
+    url = f"/trained-models/{name}/predict"
+    r_json = requests.post(ctx.url(url), json={"rows": rows}, timeout=30)
+    assert r_json.status_code == 200, r_json.text
+    r_bin = requests.post(
+        ctx.url(url), data=rowchannel.encode_columnar(
+            np.asarray(rows, np.float32)),
+        headers={"Content-Type": rowchannel.COLUMNAR_CONTENT_TYPE},
+        timeout=30)
+    assert r_bin.status_code == 200, r_bin.text
+    assert r_json.content == r_bin.content
+    r_oracle = requests.post(f"{oracle_base}{url}", json={"rows": rows},
+                             timeout=30)
+    assert r_oracle.status_code == 200
+    assert r_oracle.content == r_json.content
+    r_oracle_bin = requests.post(
+        f"{oracle_base}{url}", data=rowchannel.encode_columnar(
+            np.asarray(rows, np.float32)),
+        headers={"Content-Type": rowchannel.COLUMNAR_CONTENT_TYPE},
+        timeout=30)
+    assert r_oracle_bin.content == r_json.content
+
+
+def test_malformed_binary_body_is_406(frontend):
+    """A corrupt columnar body answers 406 naming the malformation —
+    never a 500 — through the worker path AND the threaded oracle."""
+    ctx, app, server, oracle_base = frontend
+    url = "/trained-models/fe_lr/predict"
+    good = rowchannel.encode_columnar(np.asarray([ROW], np.float32))
+    for base in (ctx.url(url), f"{oracle_base}{url}"):
+        for bad in (b"garbage", good[:10], good + b"!!"):
+            r = requests.post(
+                base, data=bad,
+                headers={"Content-Type":
+                         rowchannel.COLUMNAR_CONTENT_TYPE},
+                timeout=30)
+            assert r.status_code == 406, (base, r.status_code, r.text)
+            assert "columnar" in r.json()["result"]
+    # Wrong width decodes fine but fails design validation → 406 too.
+    r = requests.post(
+        ctx.url(url),
+        data=rowchannel.encode_columnar(np.zeros((1, 2), np.float32)),
+        headers={"Content-Type": rowchannel.COLUMNAR_CONTENT_TYPE},
+        timeout=30)
+    assert r.status_code == 406
+
+
+def test_client_sends_binary_for_numeric_rows(frontend):
+    """Model.predict_online ships the columnar body for list-form
+    numeric rows (observable in the backend's frame counters), falls
+    back to JSON for dict rows, and splits above the cap either way."""
+    ctx, app, server, _oracle = frontend
+    before = server.backend.snapshot()
+    rows = [[0.01 * i, -0.2, 1.0, 0.3] for i in range(150)]  # > 64 cap
+    out = Model(ctx).predict_online("fe_lr", rows, max_batch=64)
+    assert len(out["predictions"]) == 150
+    mid = server.backend.snapshot()
+    assert mid["predict_binary_total"] - before["predict_binary_total"] \
+        >= 3                                 # 150 rows / 64 → 3 chunks
+    # Parity with the in-process handler path on the same rows.
+    direct = app.predictor.predict("fe_lr", rows[:64])
+    assert out["probabilities"][:64] == direct["probabilities"]
+    # Dict rows: JSON fallback still answers (numeric-only model).
+    out2 = Model(ctx).predict_online(
+        "fe_lr", [{"x0": 0.5, "x1": -0.2, "x2": 1.1, "x3": 0.3}])
+    assert len(out2["predictions"]) == 1
+
+
+def test_proxied_routes_through_workers(frontend):
+    """Everything that is not the predict hot path proxies to the
+    device process: list/read routes, the Prometheus exposition, the
+    status page, 404 mapping, and the idempotency replay cache."""
+    ctx, app, server, _oracle = frontend
+    r = requests.get(ctx.url("/files"), timeout=30)
+    assert r.status_code == 200
+    assert any(d.get("filename") == "fe_train" for d in r.json())
+    assert requests.get(ctx.url("/nope"), timeout=30).status_code == 404
+    prom = requests.get(ctx.url("/metrics"),
+                        params={"format": "prometheus"}, timeout=30)
+    assert prom.status_code == 200
+    assert "lo_frontend_workers_alive" in prom.text
+    assert "text/plain" in prom.headers["Content-Type"]
+    html = requests.get(ctx.url("/status"), timeout=30)
+    assert html.status_code == 200
+    assert "text/html" in html.headers["Content-Type"]
+    doc = requests.get(ctx.url("/metrics"), timeout=30).json()
+    fr = doc["frontend"]
+    assert fr["workers"] == 2 and fr["workers_alive"] == 2
+    assert fr["predict_frames_total"] >= 1
+    # Idempotency replay survives the hop: same key → one execution.
+    key = "frontend-idem-1"
+    r1 = requests.post(ctx.url("/projections/fe_train"),
+                       json={"projection_filename": "fe_proj",
+                             "fields": ["x0"]},
+                       headers={"Idempotency-Key": key}, timeout=30)
+    r2 = requests.post(ctx.url("/projections/fe_train"),
+                       json={"projection_filename": "fe_proj",
+                             "fields": ["x0"]},
+                       headers={"Idempotency-Key": key}, timeout=30)
+    assert r1.status_code == 201
+    assert r2.status_code == 201            # replayed, not a 409
+    r3 = requests.post(ctx.url("/projections/fe_train"),
+                       json={"projection_filename": "fe_proj",
+                             "fields": ["x0"]}, timeout=30)
+    assert r3.status_code == 409            # fresh key → real duplicate
+
+
+def test_cross_process_trace_propagation(frontend):
+    """One trace id spans the worker and batcher processes: the
+    worker-rooted ``http.handle`` span parents the device process's
+    ``queue.wait``/``dispatch.device`` chain, and the trace's process
+    list shows both sides of the hop."""
+    ctx, app, server, _oracle = frontend
+    rid = "frontend-trace-test-1"
+    r = requests.post(ctx.url("/trained-models/fe_nb/predict"),
+                      json={"rows": [ROW]},
+                      headers={"X-Request-Id": rid}, timeout=30)
+    assert r.status_code == 200
+    assert r.headers["X-Request-Id"] == rid
+    tree = None
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        # The worker ships its spans right after the response bytes —
+        # poll briefly for the merge.
+        resp = requests.get(ctx.url(f"/trace/{rid}"), timeout=30)
+        if resp.status_code == 200:
+            tree = resp.json()
+            names = {s["name"] for s in tree["spans"]}
+            if {"http.handle", "queue.wait", "dispatch.device"} <= names:
+                break
+        time.sleep(0.05)
+    assert tree is not None, "trace never appeared on the primary"
+    spans = {s["span_id"]: s for s in tree["spans"]}
+    names = {s["name"] for s in spans.values()}
+    assert {"http.handle", "design.build", "queue.wait",
+            "dispatch.device"} <= names
+    assert all(s["trace_id"] == rid for s in spans.values())
+    roots = [s for s in spans.values() if not s.get("parent_id")]
+    assert [s["name"] for s in roots] == ["http.handle"]
+    root = roots[0]
+    assert root["process"] in (WORKER_PROCESS_BASE,
+                               WORKER_PROCESS_BASE + 1)
+    assert root["attrs"]["route"] == "/trained-models/{name}/predict"
+    assert root["attrs"]["status"] == 200
+    # Every device-side span chains up to the worker's root.
+    primary = [s for s in spans.values()
+               if s["process"] < WORKER_PROCESS_BASE]
+    assert primary, "no spans recorded by the device process"
+
+    def climbs_to_root(s, hops=0):
+        if s["span_id"] == root["span_id"]:
+            return True
+        p = s.get("parent_id")
+        return (hops < 10 and p in spans
+                and climbs_to_root(spans[p], hops + 1))
+
+    for s in primary:
+        assert climbs_to_root(s), (s["name"], s.get("parent_id"))
+    assert set(tree["processes"]) >= {0, root["process"]}
+
+
+class _Gate:
+    """Wedge one model's device entry (same pattern as the serving
+    fault suite): the dispatcher blocks inside ``entry.predict`` until
+    released."""
+
+    def __init__(self, app, name):
+        self.entry = app.predictor.aot.entry(name)
+        self.orig = self.entry.predict
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def __enter__(self):
+        def wedged(X, _orig=self.orig):
+            self.started.set()
+            assert self.release.wait(30), "gate never released"
+            return _orig(X)
+
+        self.entry.predict = wedged
+        return self
+
+    def __exit__(self, *exc):
+        self.release.set()
+        self.entry.predict = self.orig
+
+
+def test_backpressure_and_deadline_across_hop(frontend):
+    """QueueFull's 503 + computed Retry-After and the deadline's
+    terminal 504 both survive the worker↔batcher hop."""
+    ctx, app, server, _oracle = frontend
+    url = ctx.url("/trained-models/fe_gb/predict")
+    old_depth = app.cfg.serve_queue_depth
+    app.cfg.serve_queue_depth = 2
+    holder = {}
+    try:
+        with _Gate(app, "fe_gb") as g:
+            t1 = threading.Thread(
+                target=lambda: holder.update(r1=requests.post(
+                    url, json={"rows": [ROW]}, timeout=30)))
+            t1.start()
+            assert g.started.wait(10), "dispatcher never took r1"
+            t2 = threading.Thread(
+                target=lambda: holder.update(r2=requests.post(
+                    url, json={"rows": [ROW]}, timeout=30)))
+            t2.start()
+            deadline = time.monotonic() + 10
+            while app.predictor._batcher("fe_gb").queue_rows() < 1:
+                assert time.monotonic() < deadline, "r2 never queued"
+                time.sleep(0.02)
+            # Queue full (1 queued + 2 > depth 2) → 503 + Retry-After
+            # through the worker.
+            r3 = requests.post(url, json={"rows": [ROW, ROW]},
+                               timeout=30)
+            assert r3.status_code == 503, r3.text
+            assert float(r3.headers["Retry-After"]) >= 1
+            # Deadline expiry in queue → terminal 504 through the worker.
+            t0 = time.monotonic()
+            r4 = requests.post(url, json={"rows": [ROW]},
+                               headers={"X-Deadline-Ms": "300"},
+                               timeout=30)
+            assert r4.status_code == 504, r4.text
+            assert time.monotonic() - t0 < 5.0
+            assert "deadline exceeded" in r4.json()["result"]
+            # Malformed deadline header → 406 across the hop.
+            r5 = requests.post(url, json={"rows": [ROW]},
+                               headers={"X-Deadline-Ms": "soon"},
+                               timeout=30)
+            assert r5.status_code == 406
+            assert "X-Deadline-Ms" in r5.json()["result"]
+        t1.join(30)
+        t2.join(30)
+        assert holder["r1"].status_code == 200
+        assert holder["r2"].status_code == 200
+    finally:
+        app.cfg.serve_queue_depth = old_depth
+
+
+def test_drain_under_load_zero_loss_multiworker(frontend):
+    """Drain through the multi-worker path: the accepted in-flight
+    request completes (zero loss), new work 503s with Retry-After +
+    Connection: close from a worker, and /healthz reports ``draining``
+    from every worker."""
+    ctx, app, server, _oracle = frontend
+    url = ctx.url("/trained-models/fe_lr/predict")
+    holder = {}
+    with _Gate(app, "fe_lr") as g:
+        t1 = threading.Thread(
+            target=lambda: holder.update(r1=requests.post(
+                url, json={"rows": [ROW]}, timeout=30)))
+        t1.start()
+        assert g.started.wait(10)
+        assert not app.predictor.quiesced()
+        app.begin_drain()
+        try:
+            r = requests.post(url, json={"rows": [ROW]}, timeout=10)
+            assert r.status_code == 503
+            assert r.headers.get("Retry-After")
+            assert r.headers.get("Connection", "").lower() == "close"
+            h = requests.get(ctx.url("/healthz"), timeout=10)
+            assert h.status_code == 503
+            assert h.json()["state"] == "draining"
+        finally:
+            g.release.set()
+        t1.join(30)
+        assert holder["r1"].status_code == 200  # zero accepted drops
+        deadline = time.monotonic() + 10
+        while not app.predictor.quiesced():
+            assert time.monotonic() < deadline, "never quiesced"
+            time.sleep(0.02)
+    app._draining.clear()                   # restore for later tests
+    assert requests.post(url, json={"rows": [ROW]},
+                         timeout=30).status_code == 200
+
+
+# -- chaos: the new front-end failpoint seams ---------------------------------
+
+def _chaos_app(tmp_path, monkeypatch, spec, **cfg_kw):
+    """A dedicated 2-worker app whose workers spawn with
+    LO_TPU_FAILPOINTS armed (the supervisor strips it on respawn, so a
+    one-shot seam cannot become a crash loop)."""
+    monkeypatch.setenv("LO_TPU_FAILPOINTS", spec)
+    cfg = _make_cfg(tmp_path, workers=2, **cfg_kw)
+    app = _build_app(cfg, ["nb"])
+    server = app.serve(background=True)
+    app.predictor.predict("fe_nb", [ROW])   # warm the ladder
+    return app, server
+
+
+def test_front_pre_forward_raise_is_retryable_503(tmp_path, monkeypatch):
+    """raise-mode at pre_forward: the device never saw the request, the
+    worker answers a retryable 503 with Retry-After, and the stock
+    client completes."""
+    app, server = _chaos_app(tmp_path, monkeypatch,
+                             "serving.front.pre_forward=raise")
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        ctx = Context(base, retries=6, backoff_seconds=0.05,
+                      retry_after_cap=0.2)
+        out = Model(ctx).predict_online("fe_nb", [ROW])
+        assert len(out["predictions"]) == 1
+        # Raw probe: one of the two workers may still hold its one-shot.
+        r = requests.post(f"{base}/trained-models/fe_nb/predict",
+                          json={"rows": [ROW]}, timeout=30)
+        assert r.status_code in (200, 503)
+        if r.status_code == 503:
+            assert r.headers.get("Retry-After")
+    finally:
+        server.stop()
+
+
+def test_front_pre_reply_raise_is_retryable_503(tmp_path, monkeypatch):
+    """raise-mode at pre_reply: the answer was computed but the relay
+    seam failed — the client still gets a typed retryable 503 (never a
+    hang; /predict is read-like so the retry re-executes safely) and
+    the stock client completes."""
+    app, server = _chaos_app(tmp_path, monkeypatch,
+                             "serving.front.pre_reply=raise")
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        ctx = Context(base, retries=6, backoff_seconds=0.05,
+                      retry_after_cap=0.2)
+        out = Model(ctx).predict_online("fe_nb", [ROW])
+        assert len(out["predictions"]) == 1
+    finally:
+        server.stop()
+
+
+def test_front_worker_crash_mid_request_self_heals(tmp_path,
+                                                   monkeypatch):
+    """crash-mode at pre_forward: the worker PROCESS dies mid-request.
+    The client's stock connection-error retry lands on a live sibling
+    (or a respawned worker — the supervisor strips the failpoint on
+    respawn), the call completes, and the supervisor's respawn counters
+    show the self-healing."""
+    app, server = _chaos_app(tmp_path, monkeypatch,
+                             "serving.front.pre_forward=crash")
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        ctx = Context(base, retries=8, backoff_seconds=0.05,
+                      retry_after_cap=0.2)
+        t0 = time.monotonic()
+        out = Model(ctx).predict_online("fe_nb", [ROW])
+        assert len(out["predictions"]) == 1   # completed, never hung
+        assert time.monotonic() - t0 < 30
+        deadline = time.monotonic() + 15
+        while server.supervisor.alive() < 2:
+            assert time.monotonic() < deadline, "workers never respawned"
+            time.sleep(0.05)
+        snap = server.snapshot()
+        assert snap["respawns_total"] >= 1
+        assert snap["workers_alive"] == 2
+        # Respawned workers are disarmed: the service is fully healthy.
+        r = requests.post(f"{base}/trained-models/fe_nb/predict",
+                          json={"rows": [ROW]}, timeout=30)
+        assert r.status_code == 200
+    finally:
+        server.stop()

@@ -604,11 +604,17 @@ class FailpointCoverageRule(Rule):
     _COMMIT_CALLS = ("os.rename", "os.replace", "os.fsync")
     #: serving/ trigger suffixes: the device dispatch the batcher's
     #: coalescing loop makes (``entry.predict(...)`` — an AOT entry
-    #: bound locally, so the dotted name is stable) and the HTTP
-    #: response-write boundary (``self.wfile.write``). Both are the
-    #: exact seams the serving chaos tests (wedged dispatcher, deadline
-    #: expiry, committed-but-unsent response) must be able to reach.
-    _SERVING_TRIGGER_SUFFIXES = ("entry.predict", "wfile.write")
+    #: bound locally, so the dotted name is stable), the HTTP
+    #: response-write boundary (``self.wfile.write``), and the
+    #: multi-worker front end's request-relay seam — a worker queuing a
+    #: frame onto the row channel (``chan.queue_frame``), where the
+    #: pre_forward/pre_reply chaos pair must be able to crash/stall a
+    #: request mid-hop (tests/test_frontend.py). All are the exact
+    #: seams the serving chaos tests (wedged dispatcher, deadline
+    #: expiry, committed-but-unsent response, worker death mid-request)
+    #: must be able to reach.
+    _SERVING_TRIGGER_SUFFIXES = ("entry.predict", "wfile.write",
+                                 "chan.queue_frame")
 
     def applies(self, relpath: str) -> bool:
         return _in(relpath, *self.SCOPE)
