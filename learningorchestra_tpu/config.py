@@ -244,12 +244,29 @@ class Settings:
         default_factory=lambda: _env("LO_TPU_SERVE_DEADLINE_CAP_MS",
                                      600000.0)
     )
+    #: Device replicas of the online predict plane: each replica is a
+    #: full AOT bucket ladder compiled for (and params resident on) its
+    #: own local device, with its own dispatcher thread + bounded queue;
+    #: a router sends each request to the replica with the lowest
+    #: predicted queue wait. ``1`` (the default) preserves the
+    #: single-device topology byte-for-byte (``jax.local_devices()[0]``,
+    #: one dispatcher per model — exactly the pre-replication tier);
+    #: ``0`` means ALL local devices; ``N`` clamps to the locally
+    #: available device count. Quarantine, self-healing, drain and chaos
+    #: failpoints are all per-replica — a crashed replica degrades
+    #: capacity, not availability.
+    serve_replicas: int = field(
+        default_factory=lambda: _env("LO_TPU_SERVE_REPLICAS", 1)
+    )
     #: Consecutive dispatcher-thread crashes (exceptions escaping the
     #: dispatch loop, not per-request model errors) before a model is
     #: QUARANTINED: its predicts answer a terminal 503 naming the
     #: quarantine instead of endlessly crash-looping, and the
     #: ``serving_quarantined`` alert fires. A successful dispatch resets
     #: the streak; DELETE or re-save (invalidate) lifts the quarantine.
+    #: With ``serve_replicas > 1`` the threshold applies PER REPLICA —
+    #: one poisoned replica quarantines alone while siblings keep
+    #: serving.
     serve_quarantine_crashes: int = field(
         default_factory=lambda: _env("LO_TPU_SERVE_QUARANTINE_CRASHES", 3)
     )

@@ -21,11 +21,17 @@ Design points:
   zeros and sliced off the output (per-row programs mask nothing —
   every family's predict is row-local, so pad rows cannot perturb real
   rows).
-- **Single-device placement**: micro-batches (≤ a few hundred rows)
-  cannot amortize a mesh shard, and single-device programs carry no
-  collectives — so the online tier is safe per-process even on a
-  multi-process pod (no SPMD dispatch scope needed; contrast
-  ``MeshRuntime.shard_rows``).
+- **Per-device replicas, not mesh shards**: micro-batches (≤ a few
+  hundred rows) cannot amortize a mesh shard, and single-device
+  programs carry no collectives — so the online tier is safe
+  per-process even on a multi-process pod (no SPMD dispatch scope
+  needed; contrast ``MeshRuntime.shard_rows``). ``serve_replicas``
+  (``LO_TPU_SERVE_REPLICAS``) replicates the whole bucket ladder
+  across N local devices instead: params ``device_put`` to each
+  replica's device, one compiled ladder per device, every replica
+  bit-identical by the row-wise-evaluation argument below. The default
+  (1) preserves the single-device topology byte-for-byte; 0 means all
+  local devices.
 - **Donated inputs**: the batch buffer is donated to the executable
   where the backend supports it (TPU/GPU), so dispatch writes the
   output into the input's HBM pages instead of allocating per request.
@@ -54,6 +60,22 @@ from learningorchestra_tpu.utils import failpoints, resources
 #: half-cached entry), slow/hang-mode that compile stalls block only the
 #: loading model's requests (per-name lock, docs/fault_tolerance.md §7).
 FP_PRE_COMPILE = failpoints.declare("serving.aot.pre_compile")
+
+
+def resolve_replicas(cfg: Settings) -> int:
+    """How many device replicas the online predict plane runs
+    (``serve_replicas``): 1 — the default — is today's single-device
+    topology, kept byte-for-byte; 0 means one replica per local device;
+    any other N clamps to the locally addressable device count (never
+    the global pod list — other processes' devices are not addressable
+    from here)."""
+    n = int(cfg.serve_replicas)
+    if n == 1:
+        return 1
+    import jax
+
+    avail = max(1, len(jax.local_devices()))
+    return avail if n <= 0 else min(n, avail)
 
 
 def predict_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -234,7 +256,7 @@ class AotModel:
 
     def __init__(self, name: str, version: Tuple[int, int],
                  manifest: Dict[str, Any], model,
-                 buckets: Sequence[int]):
+                 buckets: Sequence[int], replicas: int = 1):
         import jax
         import jax.numpy as jnp
 
@@ -254,12 +276,32 @@ class AotModel:
         self.kind = manifest["kind"]
         self.buckets = tuple(buckets)
         self.n_features = len(pp["feature_fields"])
+        #: Swap-epoch token stamped by the cache on insert: strictly
+        #: increasing per model name across rebuilds, so any response
+        #: evaluated through this entry is attributable to exactly one
+        #: version-swap generation (the mesh-epoch discipline applied to
+        #: the registry version token). 0 until the cache stamps it.
+        self.swap_epoch = 0
         # local_devices, not devices: after jax.distributed init the
         # global list leads with the coordinator's devices, which are
         # non-addressable from other pod processes — each process must
-        # pin its online tier to a device it owns.
-        self._device = jax.local_devices()[0]
-        self._params = jax.device_put(model.params, self._device)
+        # pin its online tier to devices it owns. Replica i is pinned to
+        # local device i; replicas beyond the local device count would
+        # double up on a device for zero parallelism, so they clamp.
+        local = jax.local_devices()
+        self.n_replicas = max(1, min(int(replicas), len(local)))
+        self._devices = local[:self.n_replicas]
+        #: Host bytes of one params pytree, and the total replicated
+        #: device footprint (× n_replicas) — the AOT cache snapshot's
+        #: bytes accounting, next to compile_s.
+        self.params_bytes_per_replica = int(sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree.leaves(model.params)))
+        self.params_bytes = self.params_bytes_per_replica * self.n_replicas
+        self._params_r = [jax.device_put(model.params, d)
+                          for d in self._devices]
+        self._device = self._devices[0]
+        self._params = self._params_r[0]
         # Donation rewrites the batch buffer in place on backends that
         # support it; the CPU test rig would only log a warning per call.
         donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
@@ -285,27 +327,35 @@ class AotModel:
         x_specs = {
             b: jax.ShapeDtypeStruct((b, self.n_features), jnp.float32)
             for b in self.buckets}
-        # The whole bucket ladder is a compile site the resource plane
-        # accounts: wall time of the ladder build plus the XLA
-        # backend-compile seconds the monitoring listener attributes to
-        # this window (lo_compile_* on /metrics; docs/observability.md).
+        # The whole bucket-ladder set is a compile site the resource
+        # plane accounts: wall time of the build (all replicas) plus the
+        # XLA backend-compile seconds the monitoring listener attributes
+        # to this window (lo_compile_* on /metrics;
+        # docs/observability.md). Lowering against each replica's
+        # committed params pins each ladder to that replica's device —
+        # explicit placement, so dispatchers on different replicas never
+        # contend for one device.
         resources.ensure_listener()
         c0 = resources.compile_seconds()
         t0 = time.monotonic()
-        self._programs = {
-            b: jitted.lower(self._params, x_specs[b]).compile()
-            for b in self.buckets}
-        #: Wall seconds this model's ladder took to build, and the XLA
-        #: backend-compile share of it — surfaced per load on the AOT
-        #: cache snapshot so a hot-swap's recompile cost is attributable.
+        self._programs_r = [
+            {b: jitted.lower(params, x_specs[b]).compile()
+             for b in self.buckets}
+            for params in self._params_r]
+        self._programs = self._programs_r[0]
+        #: Wall seconds this model's ladder(s) took to build, and the
+        #: XLA backend-compile share of it — surfaced per load on the
+        #: AOT cache snapshot so a hot-swap's recompile cost is
+        #: attributable.
         self.compile_wall_s = round(time.monotonic() - t0, 6)
         self.compile_s = round(resources.compile_seconds() - c0, 6)
 
-    def predict_padded(self, X: np.ndarray) -> np.ndarray:
+    def predict_padded(self, X: np.ndarray, replica: int = 0) -> np.ndarray:
         """One device dispatch for a host batch of ≤ max-bucket rows:
         pad → compiled executable → host probs sliced to the true count.
-        This is the ONLY device entry of the online tier; the batcher's
-        dispatcher thread owns it."""
+        This is the ONLY device entry of the online tier; replica
+        ``replica``'s dispatcher thread owns that replica's device
+        (replica 0 — the default — is the single-device topology)."""
         import jax
 
         n = len(X)
@@ -315,17 +365,22 @@ class AotModel:
                 [X, np.zeros((bucket - n, self.n_features), np.float32)],
                 axis=0)
         x_dev = jax.device_put(np.ascontiguousarray(X, np.float32),
-                               self._device)
-        return np.asarray(self._programs[bucket](self._params, x_dev))[:n]
+                               self._devices[replica])
+        return np.asarray(self._programs_r[replica][bucket](
+            self._params_r[replica], x_dev))[:n]
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        """Probabilities for any host batch; rows beyond the largest
-        bucket run as successive max-bucket dispatches."""
+    def predict(self, X: np.ndarray, replica: int = 0) -> np.ndarray:
+        """Probabilities for any host batch on the given replica's
+        device; rows beyond the largest bucket run as successive
+        max-bucket dispatches. Bit-identical across replicas: the
+        row-wise program pins per-row numerics to a (1, d) compute
+        shape, and every replica compiles the identical program from
+        the identical params bytes."""
         max_b = self.buckets[-1]
         if len(X) <= max_b:
-            return self.predict_padded(X)
+            return self.predict_padded(X, replica)
         return np.concatenate(
-            [self.predict_padded(X[i:i + max_b])
+            [self.predict_padded(X[i:i + max_b], replica)
              for i in range(0, len(X), max_b)], axis=0)
 
 
@@ -340,9 +395,21 @@ class AotCache:
         self.registry = registry
         self.cfg = cfg or global_settings
         self.buckets = predict_buckets(self.cfg.serve_max_batch)
+        #: Device replicas every entry's ladder is compiled for —
+        #: resolved ONCE so every model in this cache has the same
+        #: replica topology (the router and the dispatcher set in
+        #: serving/batcher.py are sized off the same number).
+        self.replicas = resolve_replicas(self.cfg)
         self._lock = threading.Lock()
         self._models: Dict[str, AotModel] = {}
         self._name_locks: Dict[str, threading.Lock] = {}
+        #: Per-name swap epoch: bumped each time a (re)built entry is
+        #: inserted, stamped onto the entry. Because ONE AotModel holds
+        #: ALL replicas' params+ladders and the name maps to exactly one
+        #: entry, every replica of a model always serves the same
+        #: version — the epoch is the observable token proving which
+        #: swap generation a response came from.
+        self._epochs: Dict[str, int] = {}
         self._compiles = 0
         self._evictions = 0
         self._hits = 0
@@ -404,7 +471,8 @@ class AotCache:
                 if after == version:
                     break
                 version = after
-            ent = AotModel(name, version, manifest, model, self.buckets)
+            ent = AotModel(name, version, manifest, model, self.buckets,
+                           replicas=self.replicas)
             # Deleted while we compiled? Re-probe before caching: the
             # bucket-ladder compile takes seconds, and inserting after a
             # DELETE's invalidate() would pin device params for a model
@@ -416,8 +484,15 @@ class AotCache:
             with self._lock:
                 if stale:
                     self._evictions += 1
+                # Stamp the swap epoch under the same lock that makes
+                # the entry visible: readers that observe the new entry
+                # observe its (strictly increasing) epoch atomically, so
+                # no two responses from one epoch can span a version
+                # swap.
+                ent.swap_epoch = self._epochs.get(name, 0) + 1
+                self._epochs[name] = ent.swap_epoch
                 self._models[name] = ent
-                self._compiles += len(self.buckets)
+                self._compiles += len(self.buckets) * ent.n_replicas
                 self._compile_s = round(
                     self._compile_s + ent.compile_s, 6)
             return ent
@@ -437,4 +512,14 @@ class AotCache:
                     "compile_s": round(self._compile_s, 6),
                     "hits": self._hits,
                     "evictions": self._evictions,
-                    "buckets": list(self.buckets)}
+                    "buckets": list(self.buckets),
+                    "replicas": self.replicas,
+                    # Replicated-params device footprint of everything
+                    # currently loaded — the bytes side of the
+                    # compile_s accounting (ISSUE 16 satellite).
+                    "params_bytes": sum(
+                        m.params_bytes for m in self._models.values()),
+                    # Completed hot-swaps: epoch 1 is the cold load, so
+                    # each name contributes (epoch - 1) swaps.
+                    "swaps": sum(
+                        e - 1 for e in self._epochs.values())}

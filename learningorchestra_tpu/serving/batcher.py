@@ -2,7 +2,10 @@
 
 The request-handler + batcher-worker split: HTTP handler threads are thin
 enqueue/await shims — parse rows, enqueue, block on an event — and ONE
-dispatcher thread per model owns the device. The dispatcher coalesces
+dispatcher thread per (model, replica) owns that replica's device
+(``serve_replicas`` replicas per model; the default 1 is the classic
+one-dispatcher-per-model tier). A cost-based router picks the replica
+with the lowest predicted queue wait per request. The dispatcher coalesces
 whatever is waiting (up to ``serve_max_batch`` rows, lingering
 ``serve_max_wait_ms`` for stragglers when the batch isn't full) into one
 padded AOT dispatch (models/aot.py) and scatters the probability rows
@@ -321,12 +324,22 @@ class _Stats:
 
 
 class ModelBatcher:
-    """The per-model queue + the dispatcher thread that owns the device."""
+    """The per-(model, replica) queue + the dispatcher thread that owns
+    that replica's device. With ``serve_replicas`` = 1 (the default)
+    there is exactly one of these per model — the pre-replication tier,
+    byte-for-byte. ``stats`` is the REPLICA's own counter block: the
+    service-rate EWMA behind admission control and routing is
+    per-replica, so one slow device only slows its own queue's
+    predictions."""
 
-    def __init__(self, name: str, cfg: Settings, stats: _Stats):
+    def __init__(self, name: str, cfg: Settings, stats: _Stats,
+                 replica: int = 0):
         self.name = name
         self.cfg = cfg
         self.stats = stats
+        #: Which AOT replica (device index) this dispatcher dispatches
+        #: to; 0 is the single-device topology.
+        self.replica = int(replica)
         self._cond = threading.Condition()
         self._queue: collections.deque = collections.deque()
         self._queue_rows = 0
@@ -351,7 +364,9 @@ class ModelBatcher:
         # model errors are scattered by _loop's per-group try/except and
         # never reach supervision.
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name=f"lo-predict-{name}")
+            target=self._run, daemon=True,
+            name=(f"lo-predict-{name}" if self.replica == 0
+                  else f"lo-predict-{name}-r{self.replica}"))
         self._thread.start()
 
     # -- handler side --------------------------------------------------------
@@ -618,7 +633,12 @@ class ModelBatcher:
                     t0 = time.monotonic()
                     X = (grp[0].X if len(grp) == 1
                          else np.concatenate([p.X for p in grp], axis=0))
-                    probs = entry.predict(X)
+                    # Replica 0 calls the bare form so tests/stub
+                    # entries that monkeypatch a one-arg predict keep
+                    # working; other replicas pass their device index
+                    # through to the per-replica ladder.
+                    probs = (entry.predict(X) if self.replica == 0
+                             else entry.predict(X, self.replica))
                     t_device = time.monotonic() - t0
                 except Exception as exc:  # noqa: BLE001 — scattered per req
                     with _stats_lock:
@@ -805,17 +825,32 @@ _stats_lock = threading.Lock()
 
 
 class PredictBatcher:
-    """The serving facade: per-model batchers created lazily, shared AOT
-    cache, aggregate metrics. Held by the App; handlers call
-    :meth:`predict` and everything else is internal."""
+    """The serving facade: per-model replica sets created lazily, shared
+    AOT cache, aggregate metrics. Held by the App; handlers call
+    :meth:`predict` and everything else is internal.
+
+    With ``serve_replicas`` > 1 each model gets one :class:`ModelBatcher`
+    (queue + dispatcher thread + stats block) PER replica, and
+    :meth:`predict_probs` routes each request to the replica with the
+    lowest predicted queue wait (queue depth × that replica's own
+    service-rate EWMA, ties broken by raw depth then replica index —
+    deterministic, and concentrating idle traffic on replica 0 keeps the
+    single-replica path exercised). Quarantine is per-replica: a crashed
+    replica degrades capacity while its siblings keep answering, and the
+    model-level quarantine (terminal 503) only applies when EVERY
+    replica is quarantined."""
 
     def __init__(self, registry: ModelRegistry,
                  cfg: Optional[Settings] = None):
         self.cfg = cfg or global_settings
         self.aot = AotCache(registry, self.cfg)
+        #: Replica count resolved once by the AOT cache — the dispatcher
+        #: sets here are sized to the same topology the ladders compile
+        #: for.
+        self.replicas = self.aot.replicas
         self._lock = threading.Lock()
-        self._batchers: Dict[str, ModelBatcher] = {}
-        self._stats: Dict[str, _Stats] = {}
+        self._batchers: Dict[str, List[ModelBatcher]] = {}
+        self._stats: Dict[str, List[_Stats]] = {}
         self._stopped = False
         #: Requests currently inside :meth:`predict` — including the
         #: handler phase (design build, first-touch compile) BEFORE the
@@ -824,33 +859,57 @@ class PredictBatcher:
         #: is still preprocessing would 503 it mid-drain.
         self._active = 0
 
-    def _batcher(self, name: str) -> ModelBatcher:
+    def _replica_set(self, name: str) -> List[ModelBatcher]:
+        """The model's full dispatcher set, created lazily (all replicas
+        at once — a model is either replicated or not, never half)."""
         with self._lock:
             if self._stopped:
                 # A handler racing Server.stop() must not resurrect a
                 # dispatcher thread nothing will ever stop again.
                 raise BatcherStopped(
                     f"predict tier stopped; model {name} not served")
-            b = self._batchers.get(name)
-            if b is not None:
-                reason = b.quarantined()
-                if reason:
-                    raise ModelQuarantined(reason)
-            if b is None:
-                # Re-validate before spawning a dispatcher: a request
+            bs = self._batchers.get(name)
+            if bs is None:
+                # Re-validate before spawning dispatchers: a request
                 # racing DELETE can reach here after invalidate()
-                # already tore the batcher down — without this check it
-                # would resurrect a dispatcher thread for a model that
+                # already tore the batchers down — without this check it
+                # would resurrect dispatcher threads for a model that
                 # can never serve again.
                 self.aot.registry.version(name)   # ModelNotFound → 404
-                stats = self._stats.setdefault(name, _Stats())
+                stats = self._stats.setdefault(
+                    name, [_Stats() for _ in range(self.replicas)])
                 with _stats_lock:
-                    # A fresh dispatcher (post-DELETE/re-save) lifts any
+                    # Fresh dispatchers (post-DELETE/re-save) lift any
                     # previous quarantine; the counter history survives.
-                    stats.quarantined = 0
-                b = ModelBatcher(name, self.cfg, stats)
-                self._batchers[name] = b
+                    for st in stats:
+                        st.quarantined = 0
+                bs = [ModelBatcher(name, self.cfg, stats[i], replica=i)
+                      for i in range(self.replicas)]
+                self._batchers[name] = bs
+            return bs
+
+    def _batcher(self, name: str) -> ModelBatcher:
+        """The replica this request dispatches to: the cost-based
+        router. Cost = predicted queue wait (depth × that replica's own
+        service-rate EWMA), ties broken by raw queue depth, then replica
+        index. Quarantined replicas are excluded; only when EVERY
+        replica is quarantined does the model answer the terminal
+        quarantine 503."""
+        bs = self._replica_set(name)
+        if len(bs) == 1:
+            b = bs[0]
+            reason = b.quarantined()
+            if reason:
+                raise ModelQuarantined(reason)
             return b
+        live = [b for b in bs if b.quarantined() is None]
+        if not live:
+            raise ModelQuarantined(bs[0].quarantined())
+        depths = [(b, b.queue_rows()) for b in live]
+        with _stats_lock:
+            scored = [(b.stats.predicted_wait_s(q), q, b.replica, b)
+                      for b, q in depths]
+        return min(scored)[3]
 
     def predict(self, name: str, rows: Sequence[Any],
                 deadline_ms: Optional[float] = None) -> Dict[str, Any]:
@@ -888,13 +947,33 @@ class PredictBatcher:
         with self._lock:
             self._active += 1
         try:
-            return self._predict(name, rows, deadline_ms)
+            entry, probs = self._predict(name, rows, deadline_ms)
+            return entry.kind, probs
+        finally:
+            with self._lock:
+                self._active -= 1
+
+    def predict_with_epoch(self, name: str, rows: Sequence[Any],
+                           deadline_ms: Optional[float] = None
+                           ) -> Tuple[str, np.ndarray, int]:
+        """:meth:`predict_probs` plus the swap epoch of the AOT entry
+        the rows evaluated through — the hot-swap consistency probe: the
+        epoch is stamped once per (name, version) cache insert under the
+        cache lock, so two responses with the same epoch are guaranteed
+        to have been served by the SAME model version on every replica
+        (no mixed-version pair can share an epoch). Accounting is
+        identical to :meth:`predict_probs` by construction."""
+        with self._lock:
+            self._active += 1
+        try:
+            entry, probs = self._predict(name, rows, deadline_ms)
+            return entry.kind, probs, entry.swap_epoch
         finally:
             with self._lock:
                 self._active -= 1
 
     def _predict(self, name: str, rows: Sequence[Any],
-                 deadline_ms: Optional[float]) -> Tuple[str, np.ndarray]:
+                 deadline_ms: Optional[float]) -> Tuple[Any, np.ndarray]:
         deadline = budget_ms = None
         if deadline_ms is not None:
             if deadline_ms <= 0:
@@ -904,9 +983,12 @@ class PredictBatcher:
                 # lo_serving_deadline_exceeded_total and the rate alert.
                 self.aot.registry.version(name)   # unknown model → 404
                 with self._lock:
-                    stats = self._stats.setdefault(name, _Stats())
+                    stats = self._stats.setdefault(
+                        name, [_Stats() for _ in range(self.replicas)])
                 with _stats_lock:
-                    stats.deadline_exceeded += 1
+                    # Never routed, so it charges replica 0 — the
+                    # aggregate (what the rate alert reads) is the sum.
+                    stats[0].deadline_exceeded += 1
                 exc = DeadlineExceeded(name, float(deadline_ms), 0.0,
                                        "admission")
                 tracing.record_span(
@@ -928,19 +1010,22 @@ class PredictBatcher:
             # Count the rejection: a tier bouncing 100% of traffic must
             # show it on /metrics, not read as zero rejections.
             with self._lock:
-                stats = self._stats.setdefault(name, _Stats())
+                stats = self._stats.setdefault(
+                    name, [_Stats() for _ in range(self.replicas)])
             with _stats_lock:
-                stats.rejected += 1
+                stats[0].rejected += 1
             raise QueueFull(name, 0)
-        # Quarantine check BEFORE any per-request work: a quarantined
-        # model's terminal 503 should cost a dict lookup, not a design
-        # build (the _batcher() re-check still guards the race).
+        # Quarantine check BEFORE any per-request work: a fully
+        # quarantined model's terminal 503 should cost a dict lookup,
+        # not a design build (the _batcher() re-check still guards the
+        # race). Partially quarantined sets fall through — the router
+        # only considers live replicas.
         with self._lock:
-            b = self._batchers.get(name)
-        if b is not None:
-            reason = b.quarantined()
-            if reason:
-                raise ModelQuarantined(reason)
+            bs = self._batchers.get(name)
+        if bs is not None:
+            reasons = [b.quarantined() for b in bs]
+            if all(reasons):
+                raise ModelQuarantined(reasons[0])
         # Load/compile (and 404/406) BEFORE enqueueing: a bad model name
         # must not cost a queue slot, and first-touch compile happens on
         # the handler thread instead of stalling the dispatch loop.
@@ -976,7 +1061,7 @@ class PredictBatcher:
                             attrs={"model": name, "rows": len(rows)})
         probs = self._batcher(name).submit(X, entry, deadline=deadline,
                                            budget_ms=budget_ms)
-        return entry.kind, probs
+        return entry, probs
 
     def invalidate(self, name: Optional[str] = None) -> None:
         """Drop compiled programs (and the dispatcher thread) for a
@@ -988,14 +1073,14 @@ class PredictBatcher:
         self.aot.invalidate(name)
         with self._lock:
             if name is None:
-                doomed = list(self._batchers.values())
+                doomed = [b for bs in self._batchers.values() for b in bs]
                 self._batchers.clear()
-                cleared = list(self._stats.values())
+                cleared = [st for sts in self._stats.values() for st in sts]
             else:
-                b = self._batchers.pop(name, None)
-                doomed = [b] if b is not None else []
-                st = self._stats.get(name)
-                cleared = [st] if st is not None else []
+                bs = self._batchers.pop(name, None)
+                doomed = list(bs) if bs is not None else []
+                sts = self._stats.get(name)
+                cleared = list(sts) if sts is not None else []
         for b in doomed:
             b.stop()
         with _stats_lock:
@@ -1011,12 +1096,22 @@ class PredictBatcher:
         ``serving_quarantined`` alert carries the paging signal)."""
         with self._lock:
             batchers = dict(self._batchers)
-        dead = sorted(n for n, b in batchers.items()
-                      if not b.thread_alive())
-        quarantined = sorted(n for n, b in batchers.items()
-                             if b.quarantined())
-        return {"ok": not dead, "dispatchers": len(batchers),
-                "dead": dead, "quarantined": quarantined}
+        dead = sorted(n for n, bs in batchers.items()
+                      if any(not b.thread_alive() for b in bs))
+        # A model is "quarantined" (terminal 503) only when EVERY
+        # replica is; partially quarantined models keep serving and are
+        # named per replica below — capacity degraded, not availability.
+        quarantined = sorted(n for n, bs in batchers.items()
+                             if all(b.quarantined() for b in bs))
+        quarantined_replicas = {
+            n: [b.replica for b in bs if b.quarantined()]
+            for n, bs in sorted(batchers.items())
+            if any(b.quarantined() for b in bs)}
+        return {"ok": not dead,
+                "dispatchers": sum(len(bs) for bs in batchers.values()),
+                "replicas": self.replicas,
+                "dead": dead, "quarantined": quarantined,
+                "quarantined_replicas": quarantined_replicas}
 
     def quiesced(self) -> bool:
         """True when no request is anywhere inside the tier — neither
@@ -1028,16 +1123,79 @@ class PredictBatcher:
         with self._lock:
             if self._active > 0:
                 return False
-            batchers = list(self._batchers.values())
+            batchers = [b for bs in self._batchers.values() for b in bs]
         return all(b.outstanding() == 0 for b in batchers)
+
+    def _model_snapshot(self, sts: List[_Stats],
+                        queues: List[int]) -> Dict[str, Any]:
+        """One model's snapshot doc across its replicas (caller holds
+        ``_stats_lock``). A single replica delegates to its stats block
+        verbatim — the exact pre-replication document, so the
+        replicas=1 metric surface is byte-for-byte. Multi-replica docs
+        sum counters, sum per-replica QPS, weight the service rate by
+        dispatched rows, and merge the latency HISTOGRAMS element-wise
+        before estimating percentiles (a percentile of percentiles
+        would be meaningless). Both carry a ``replicas`` list with each
+        replica's slim occupancy/rate/health row."""
+        per = [st.snapshot(q) for st, q in zip(sts, queues)]
+        if len(per) == 1:
+            doc = per[0]
+        else:
+            doc = {k: sum(p[k] for p in per)
+                   for k in ("requests", "rows", "batches", "batched_rows",
+                             "rejected", "timeouts", "errors",
+                             "deadline_exceeded", "dispatcher_restarts",
+                             "queue_rows")}
+            doc["quarantined"] = (
+                1 if all(p["quarantined"] for p in per) else 0)
+            doc["qps"] = round(sum(p["qps"] for p in per), 3)
+            doc["mean_batch_rows"] = (
+                round(doc["batched_rows"] / doc["batches"], 3)
+                if doc["batches"] else 0.0)
+            br = doc["batched_rows"]
+            doc["service_us_per_row"] = (
+                round(sum(p["service_us_per_row"] * p["batched_rows"]
+                          for p in per) / br, 3) if br else 0.0)
+            life = [sum(v) for v in
+                    zip(*(st.lat_buckets for st in sts))]
+            window = [sum(v) for v in zip(
+                *([a + b for a, b in zip(st._lat_prev, st._lat_recent)]
+                  for st in sts))]
+            source = window if sum(window) else life
+
+            def pct(q: float) -> Optional[float]:
+                est = profiling.quantile_from_buckets(source, q)
+                return None if est is None else round(est * 1e3, 3)
+
+            doc["p50_ms"] = pct(0.50)
+            doc["p99_ms"] = pct(0.99)
+            doc["latency"] = {
+                "buckets": life,
+                "sum_s": round(sum(st.lat_sum_s for st in sts), 6)}
+        doc["replicas"] = [
+            {"replica": i,
+             "queue_rows": p["queue_rows"],
+             "qps": p["qps"],
+             "service_us_per_row": p["service_us_per_row"],
+             "requests": p["requests"],
+             "rows": p["rows"],
+             "batches": p["batches"],
+             "batched_rows": p["batched_rows"],
+             "mean_batch_rows": p["mean_batch_rows"],
+             "dispatcher_restarts": p["dispatcher_restarts"],
+             "quarantined": p["quarantined"]}
+            for i, p in enumerate(per)]
+        return doc
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             names = list(self._stats)
-            queue = {n: (self._batchers[n].queue_rows()
-                         if n in self._batchers else 0) for n in names}
+            queue = {n: ([b.queue_rows() for b in self._batchers[n]]
+                         if n in self._batchers
+                         else [0] * len(self._stats[n])) for n in names}
         with _stats_lock:
-            models = {n: self._stats[n].snapshot(queue[n]) for n in names}
+            models = {n: self._model_snapshot(self._stats[n], queue[n])
+                      for n in names}
         agg: Dict[str, Any] = {
             "requests": sum(m["requests"] for m in models.values()),
             "rows": sum(m["rows"] for m in models.values()),
@@ -1063,7 +1221,7 @@ class PredictBatcher:
     def stop(self) -> None:
         with self._lock:
             self._stopped = True
-            batchers = list(self._batchers.values())
+            batchers = [b for bs in self._batchers.values() for b in bs]
             self._batchers.clear()
         for b in batchers:
             b.stop()

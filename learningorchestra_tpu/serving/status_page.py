@@ -140,6 +140,7 @@ def _serving_section(serving: Optional[Dict[str, Any]],
         rows.append([
             escape(str(name)),
             _badge("quarantined" if m.get("quarantined") else "ok"),
+            _replica_cells(m.get("replicas")),
             escape(str(m.get("requests", 0))),
             escape(str(m.get("qps", 0))),
             escape(str(m.get("mean_batch_rows", 0))),
@@ -151,11 +152,27 @@ def _serving_section(serving: Optional[Dict[str, Any]],
             escape(str(m.get("deadline_exceeded", 0))),
             escape(str(m.get("dispatcher_restarts", 0))),
         ])
-    table = _table(["model", "state", "requests", "qps", "rows/batch",
-                    "queue", "p50 (ms)", "p99 (ms)", "phase p99s (ms)",
-                    "rejected (503)", "expired (504)", "restarts"], rows)
+    table = _table(["model", "state", "replicas", "requests", "qps",
+                    "rows/batch", "queue", "p50 (ms)", "p99 (ms)",
+                    "phase p99s (ms)", "rejected (503)", "expired (504)",
+                    "restarts"], rows)
     return (f"<h2>Online predict ({len(rows)} models)</h2>"
             f"<p>{agg}</p>{table}")
+
+
+def _replica_cells(replicas: Optional[List[Dict[str, Any]]]) -> str:
+    """One compact line per device replica: index, queue depth, qps and
+    quarantine flag — the router's view of the replica plane, readable
+    without curling the per-replica Prometheus series."""
+    if not replicas:
+        return ""
+    parts = []
+    for r in replicas:
+        state = " ⛔" if r.get("quarantined") else ""
+        parts.append(escape(
+            f"r{r.get('replica', '?')}: q={r.get('queue_rows', 0)} "
+            f"qps={r.get('qps', 0)}{state}"))
+    return "<br>".join(parts)
 
 
 def _alerts_section(alerts: Optional[Dict[str, Any]]) -> str:
@@ -195,6 +212,13 @@ def _resources_section(res: Optional[Dict[str, Any]]) -> str:
         ("host rss", _fmt_bytes(host.get("rss_bytes"))),
         ("open fds", host.get("open_fds")),
         ("device bytes", _fmt_bytes(dev.get("total_bytes_in_use"))),
+        # Per-device occupancy as compact d<i>=<bytes> pairs — with
+        # replicated serving params every replica's device shows up,
+        # not just device 0; devices holding nothing are elided.
+        ("per device", " ".join(
+            f"d{i}={_fmt_bytes(d['bytes_in_use'])}"
+            for i, d in enumerate(dev.get("devices") or [])
+            if d.get("bytes_in_use")) or None),
         ("device source", dev.get("source")),
         ("store", _fmt_bytes(disk.get("store_bytes"))),
         ("disk free", _fmt_bytes(disk.get("free_bytes"))),

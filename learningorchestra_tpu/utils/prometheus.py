@@ -208,6 +208,39 @@ def render(doc: Dict[str, Any]) -> str:
             w.histogram("lo_serving_latency_seconds", {"model": model},
                         buckets, hist.get("sum_s", 0.0),
                         m.get("requests", 0))
+        # Per-replica plane (serve_replicas): each replica's dispatcher
+        # occupancy, routing inputs, and health, labeled
+        # {model=...,replica=...}. Rendered for every topology — at
+        # replicas=1 the single replica-0 row equals the model row.
+        for key in ("batches", "batched_rows", "dispatcher_restarts"):
+            name = f"lo_serving_replica_{key}_total"
+            w.header(name, _COUNTER,
+                     f"Online predict tier {key} per device replica")
+            for model, m in sorted(models.items()):
+                for r in m.get("replicas") or []:
+                    w.sample(name,
+                             {"model": model, "replica": r["replica"]},
+                             r.get(key, 0))
+        for key in ("queue_rows", "qps", "service_us_per_row",
+                    "mean_batch_rows"):
+            name = f"lo_serving_replica_{key}"
+            w.header(name, _GAUGE,
+                     f"Online predict tier live {key} per device replica "
+                     "(the router's cost inputs)")
+            for model, m in sorted(models.items()):
+                for r in m.get("replicas") or []:
+                    w.sample(name,
+                             {"model": model, "replica": r["replica"]},
+                             r.get(key) or 0)
+        w.header("lo_serving_replica_quarantined", _GAUGE,
+                 "1 while this device replica is quarantined (its "
+                 "siblings keep serving; the model-level gauge only "
+                 "rises when every replica is down)")
+        for model, m in sorted(models.items()):
+            for r in m.get("replicas") or []:
+                w.sample("lo_serving_replica_quarantined",
+                         {"model": model, "replica": r["replica"]},
+                         r.get("quarantined", 0))
     aot = serving.get("aot") or {}
     if aot:
         _flat_counters(w, "lo_serving_aot", aot, _COUNTER,

@@ -209,11 +209,32 @@ def device_snapshot() -> Dict[str, Any]:
             peak_total += doc["peak_bytes_in_use"]
         docs.append(doc)
     if not have_stats:
-        # Live-buffer fallback: exact for what jax holds, process-wide.
+        # Live-buffer fallback: exact for what jax holds, attributed
+        # per device via each array's committed placement so the
+        # per-device rows (and lo_resource_device_bytes_in_use_by_device)
+        # show every replica's params residency even on the CPU rig —
+        # the old process-wide sum left every device but 0 reading as
+        # empty once the serve plane replicated params across devices.
+        per_dev: Dict[str, int] = {}
+        total = 0
         try:
-            total = sum(int(a.nbytes) for a in jax.live_arrays())
+            for a in jax.live_arrays():
+                nbytes = int(a.nbytes)
+                total += nbytes
+                try:
+                    devs = list(a.devices())
+                except Exception:  # noqa: BLE001 — deleted/donated array
+                    continue
+                if not devs:
+                    continue
+                share = nbytes // len(devs)
+                for d in devs:
+                    per_dev[str(d)] = per_dev.get(str(d), 0) + share
         except Exception:  # noqa: BLE001 — best-effort
             total = 0
+        for doc in docs:
+            if doc["id"] in per_dev:
+                doc["bytes_in_use"] = per_dev[doc["id"]]
         return {"devices": docs, "source": "live_buffers",
                 "total_bytes_in_use": total, "peak_bytes_in_use": None}
     return {"devices": docs, "source": "memory_stats",

@@ -587,6 +587,188 @@ def test_chaos_drain_sigterm_zero_loss():
             proc.stdout.close()
 
 
+# -- replicated predict plane: per-replica fault domain -----------------------
+
+RROW = [[0.5, -0.2]]
+
+
+@pytest.fixture(scope="module")
+def replicated(tmp_path_factory):
+    """Live server with serve_replicas=2: one cheap online model whose
+    AOT ladder is compiled once per device replica, the same fast fault
+    knobs as ``fault``, and both dispatchers warm."""
+    from learningorchestra_tpu.serving.app import App
+
+    tmp = tmp_path_factory.mktemp("replicated")
+    cfg = Settings()
+    cfg.store_root = str(tmp / "store")
+    cfg.image_root = str(tmp / "images")
+    cfg.port = 0
+    cfg.persist = False
+    cfg.serve_max_batch = 8
+    cfg.serve_restart_backoff_s = 0.01
+    cfg.serve_quarantine_crashes = 3
+    cfg.serve_replicas = 2
+    cfg.alert_window_s = 0.0
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(3)
+    n = 120
+    x = rng.normal(size=n)
+    ds = app.store.create("rtrain")
+    ds.append_columns({"x": x, "y": rng.normal(size=n),
+                       "label": (x > 0).astype(np.int64)})
+    app.store.finish("rtrain")
+    app.builder.build("rtrain", "rtrain", "rm", ["nb"], "label")
+    server = app.serve(background=True)
+    ctx = Context(f"http://127.0.0.1:{server.port}", poll_seconds=0.1,
+                  timeout=60)
+    app.predictor.predict("rm_nb", RROW)    # warm: compiles ALL replicas
+    yield ctx, app, server
+    server.stop()
+
+
+def _quarantine_one_replica(url):
+    """With pre_dispatch=raise:0 armed, one POST crash-loops whichever
+    replica the router picked (the batch re-queues on THAT replica's
+    queue) until it quarantines; the waiter gets the mapped 503."""
+    r = requests.post(url, json={"rows": RROW}, timeout=30)
+    assert r.status_code == 503, r.text
+    assert "quarantined" in r.json()["result"]
+
+
+def test_replica_crash_quarantines_alone(replicated):
+    """Acceptance: a single crash-looping replica quarantines ALONE —
+    capacity degrades, availability does not. /healthz names the replica
+    (not the model), the sibling keeps answering, the paging alert stays
+    quiet, and invalidate lifts the per-replica quarantine."""
+    ctx, app, server = replicated
+    url = ctx.url("/trained-models/rm_nb/predict")
+    failpoints.configure("serving.batcher.pre_dispatch=raise:0")
+    _quarantine_one_replica(url)            # router's idle tie-break → r0
+    # Disarm BEFORE the sibling serves: the failpoint is process-global,
+    # and replica 1's dispatcher would crash on its first batch too.
+    failpoints.reset()
+    r = requests.post(url, json={"rows": RROW}, timeout=30)
+    assert r.status_code == 200 and len(r.json()["predictions"]) == 1
+    h = requests.get(ctx.url("/healthz")).json()
+    disp = h["checks"]["dispatchers"]
+    assert disp["quarantined_replicas"] == {"rm_nb": [0]}
+    assert disp["quarantined"] == []        # model-level: still serving
+    assert disp["replicas"] == 2
+    snap = _model_stats(app, "rm_nb")
+    assert snap["quarantined"] == 0         # aggregate level stays down
+    per = {rep["replica"]: rep for rep in snap["replicas"]}
+    assert per[0]["quarantined"] == 1 and per[1]["quarantined"] == 0
+    assert per[0]["dispatcher_restarts"] >= 3
+    # Partial quarantine is capacity loss, not an outage: no page…
+    requests.get(ctx.url("/metrics"))       # an evaluation window
+    assert "serving_quarantined" not in requests.get(
+        ctx.url("/alerts")).json()["firing"]
+    # …but the per-replica gauge carries it on the exposition surface.
+    text = requests.get(ctx.url("/metrics"),
+                        params={"format": "prometheus"}).text
+    assert ('lo_serving_replica_quarantined'
+            '{model="rm_nb",replica="0"} 1') in text
+    assert ('lo_serving_replica_quarantined'
+            '{model="rm_nb",replica="1"} 0') in text
+    app.predictor.invalidate("rm_nb")
+    r = requests.post(url, json={"rows": RROW}, timeout=30)
+    assert r.status_code == 200
+    snap = _model_stats(app, "rm_nb")
+    assert all(rep["quarantined"] == 0 for rep in snap["replicas"])
+
+
+def test_all_replicas_quarantined_terminal(replicated):
+    """Only when EVERY replica is down does the model answer the
+    terminal quarantine 503, land on /healthz's model-level list, and
+    fire the serving_quarantined alert — and invalidate still lifts the
+    whole set at once."""
+    ctx, app, server = replicated
+    url = ctx.url("/trained-models/rm_nb/predict")
+    failpoints.configure("serving.batcher.pre_dispatch=raise:0")
+    _quarantine_one_replica(url)            # replica 0 down
+    _quarantine_one_replica(url)            # router's only live pick: r1
+    failpoints.reset()
+    # Terminal: the cheap pre-route check answers without touching a
+    # queue (and without crash-loop feeding).
+    r = requests.post(url, json={"rows": RROW}, timeout=30)
+    assert r.status_code == 503 and "quarantined" in r.json()["result"]
+    h = requests.get(ctx.url("/healthz")).json()
+    disp = h["checks"]["dispatchers"]
+    assert "rm_nb" in disp["quarantined"]
+    assert disp["quarantined_replicas"]["rm_nb"] == [0, 1]
+    assert _model_stats(app, "rm_nb")["quarantined"] == 1
+    requests.get(ctx.url("/metrics"))
+    assert "serving_quarantined" in requests.get(
+        ctx.url("/alerts")).json()["firing"]
+    app.predictor.invalidate("rm_nb")
+    r = requests.post(url, json={"rows": RROW}, timeout=30)
+    assert r.status_code == 200
+    for _ in range(2):                      # clear_windows clean reads
+        requests.get(ctx.url("/metrics"))
+    assert "serving_quarantined" not in requests.get(
+        ctx.url("/alerts")).json()["firing"]
+
+
+@pytest.mark.slow
+def test_replica8_degradation_ladder(tmp_path):
+    """Chaos (slow lane): at serve_replicas=8 on the 8-device CPU sim,
+    quarantine replicas one at a time — after each loss the survivors
+    keep answering; only the 8th loss makes the model terminal; one
+    invalidate lifts all eight."""
+    from learningorchestra_tpu.serving.app import App
+
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.image_root = str(tmp_path / "images")
+    cfg.port = 0
+    cfg.persist = False
+    cfg.serve_max_batch = 8
+    cfg.serve_restart_backoff_s = 0.01
+    cfg.serve_quarantine_crashes = 3
+    cfg.serve_replicas = 8
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(5)
+    n = 120
+    x = rng.normal(size=n)
+    ds = app.store.create("r8train")
+    ds.append_columns({"x": x, "y": rng.normal(size=n),
+                       "label": (x > 0).astype(np.int64)})
+    app.store.finish("r8train")
+    app.builder.build("r8train", "r8train", "r8", ["nb"], "label")
+    server = app.serve(background=True)
+    try:
+        ctx = Context(f"http://127.0.0.1:{server.port}",
+                      poll_seconds=0.1, timeout=60)
+        url = ctx.url("/trained-models/r8_nb/predict")
+        app.predictor.predict("r8_nb", RROW)
+        assert app.predictor.aot.entry("r8_nb").n_replicas == 8
+        for lost in range(1, 9):
+            failpoints.configure("serving.batcher.pre_dispatch=raise:0")
+            _quarantine_one_replica(url)
+            failpoints.reset()
+            h = requests.get(ctx.url("/healthz")).json()
+            disp = h["checks"]["dispatchers"]
+            assert disp["quarantined_replicas"]["r8_nb"] == list(
+                range(lost))
+            if lost < 8:
+                # Survivors answer: capacity degraded, not availability.
+                r = requests.post(url, json={"rows": RROW}, timeout=30)
+                assert r.status_code == 200, f"after losing {lost}"
+                assert "r8_nb" not in disp["quarantined"]
+            else:
+                r = requests.post(url, json={"rows": RROW}, timeout=30)
+                assert r.status_code == 503
+                assert "quarantined" in r.json()["result"]
+                assert "r8_nb" in disp["quarantined"]
+        app.predictor.invalidate("r8_nb")
+        r = requests.post(url, json={"rows": RROW}, timeout=30)
+        assert r.status_code == 200
+    finally:
+        failpoints.reset()
+        server.stop()
+
+
 # -- satellite: alert + exposition plumbing -----------------------------------
 
 def test_deadline_alert_rule_and_prometheus_series(fault):

@@ -1,0 +1,318 @@
+"""Replicated predict plane (PR 16): per-device AOT replicas behind the
+cost-based router.
+
+The load-bearing guarantees under test:
+
+- ``LO_TPU_SERVE_REPLICAS`` semantics: unset/1 is the byte-for-byte
+  single-replica plane (same thread names, same snapshot document,
+  single device), 0 means every local device, N clamps to the device
+  count;
+- bit-identical parity: for EVERY online model family, responses served
+  through a replicated plane (mixed routing, concurrent clients) carry
+  the exact float32 bytes of the single-replica oracle — replication
+  must never change an answer;
+- epoch-consistent hot-swap: while a model is re-saved under sustained
+  traffic, no two responses sharing a swap epoch ever disagree — a
+  mixed-version pair (one replica on v1, another on v2) would surface
+  as two distinct probability byte-patterns under one epoch;
+- replicated params residency is visible: the per-device HBM fallback
+  attributes live-buffer bytes to EVERY device holding a replica, not
+  just device 0, and the AOT snapshot carries the multiplied footprint;
+- the ``lo_serving_replica_*`` exposition series render per
+  (model, replica) through the production grammar.
+"""
+
+import copy
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.models import aot as aot_mod
+from learningorchestra_tpu.models.registry import ONLINE_KINDS
+from learningorchestra_tpu.serving.batcher import PredictBatcher
+
+ROW0 = [0.5, -0.2]
+
+
+@pytest.fixture(scope="module")
+def fitted(tmp_path_factory):
+    """One App with every online family fit on a tiny two-feature
+    dataset; replicated planes are built per-test as extra
+    PredictBatchers over the SAME registry, so oracle and replicas
+    serve the identical saved params."""
+    from learningorchestra_tpu.serving.app import App
+
+    tmp = tmp_path_factory.mktemp("replicas")
+    cfg = Settings()
+    cfg.store_root = str(tmp / "store")
+    cfg.image_root = str(tmp / "images")
+    cfg.port = 0
+    cfg.persist = False
+    cfg.serve_max_batch = 8
+    app = App(cfg, recover=False)
+    rng = np.random.default_rng(11)
+    n = 150
+    x = rng.normal(size=n)
+    y = rng.normal(size=n)
+    ds = app.store.create("ptrain")
+    ds.append_columns({"x": x, "y": y,
+                       "label": (x + 0.3 * y > 0).astype(np.int64)})
+    app.store.finish("ptrain")
+    app.builder.build("ptrain", "ptrain", "pm", list(ONLINE_KINDS),
+                      "label")
+    server = app.serve(background=True)
+    yield app, cfg
+    server.stop()
+
+
+def _plane(app, cfg, n_replicas):
+    """A fresh replicated predict plane over the fixture's registry."""
+    rcfg = copy.deepcopy(cfg)
+    rcfg.serve_replicas = n_replicas
+    return PredictBatcher(app.builder.registry, rcfg)
+
+
+# -- knob semantics -----------------------------------------------------------
+
+def test_resolve_replicas_semantics():
+    import jax
+
+    avail = len(jax.local_devices())
+    assert avail >= 2, "tests expect the forced multi-device CPU sim"
+    cfg = Settings()
+    assert cfg.serve_replicas == 1          # default: single replica
+    assert aot_mod.resolve_replicas(cfg) == 1
+    cfg.serve_replicas = 0                  # 0 = every local device
+    assert aot_mod.resolve_replicas(cfg) == avail
+    cfg.serve_replicas = 2
+    assert aot_mod.resolve_replicas(cfg) == 2
+    cfg.serve_replicas = avail + 64         # clamps, never oversubscribes
+    assert aot_mod.resolve_replicas(cfg) == avail
+
+
+def test_default_single_replica_surface(fitted):
+    """Unset/1 keeps the pre-replication plane byte-for-byte: one
+    device, the unsuffixed dispatcher thread name, and a snapshot whose
+    model document IS the single stats block (plus the replicas list)."""
+    import jax
+
+    app, cfg = fitted
+    app.predictor.predict_probs("pm_nb", [ROW0])
+    entry = app.predictor.aot.entry("pm_nb")
+    assert entry.n_replicas == 1
+    assert entry.params_bytes == entry.params_bytes_per_replica
+    assert entry._devices == [jax.local_devices()[0]]
+    names = {t.name for t in threading.enumerate()}
+    assert "lo-predict-pm_nb" in names
+    assert not any(t.startswith("lo-predict-pm_nb-r") for t in names)
+    snap = app.predictor.snapshot()
+    m = snap["models"]["pm_nb"]
+    assert [r["replica"] for r in m["replicas"]] == [0]
+    assert m["requests"] == m["replicas"][0]["requests"]
+    assert snap["aot"]["replicas"] == 1
+    assert app.predictor.health()["replicas"] == 1
+
+
+# -- bit-identical parity across every family ---------------------------------
+
+def _parity_check(app, cfg, n_replicas, passes=2, workers=8):
+    rng = np.random.default_rng(99)
+    # 8 rows = the fixture's serve_max_batch (the per-request cap).
+    queries = rng.normal(size=(8, 2)).tolist()
+    # Oracle: the App's own replicas=1 plane, same registry/params.
+    oracle = {}
+    for kind in ONLINE_KINDS:
+        name = f"pm_{kind}"
+        k, probs = app.predictor.predict_probs(name, queries)
+        assert probs.dtype == np.float32
+        oracle[name] = (k, probs.shape, probs.tobytes())
+    pb = _plane(app, cfg, n_replicas)
+    try:
+        for kind in ONLINE_KINDS:           # warm every replicated ladder
+            pb.predict_probs(f"pm_{kind}", queries[:1])
+        names = {t.name for t in threading.enumerate()}
+        assert f"lo-predict-pm_nb-r{n_replicas - 1}" in names
+        errors = []
+
+        def client(seed):
+            r = np.random.default_rng(seed)
+            order = list(ONLINE_KINDS) * passes
+            r.shuffle(order)
+            for kind in order:
+                name = f"pm_{kind}"
+                try:
+                    k, probs = pb.predict_probs(name, queries)
+                    got = (k, probs.shape, probs.tobytes())
+                    if got != oracle[name]:
+                        errors.append(f"{name}: bytes != oracle")
+                except Exception as exc:  # noqa: BLE001 — report, not hang
+                    errors.append(f"{name}: {exc!r}")
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors[:5]
+        snap = pb.snapshot()
+        assert snap["aot"]["replicas"] == n_replicas
+        for kind in ONLINE_KINDS:
+            m = snap["models"][f"pm_{kind}"]
+            per = m["replicas"]
+            assert len(per) == n_replicas
+            # The aggregate document is exactly the per-replica sum.
+            assert m["requests"] == sum(r["requests"] for r in per)
+            assert m["batched_rows"] == sum(r["batched_rows"]
+                                            for r in per)
+    finally:
+        pb.stop()
+
+
+def test_parity_two_replicas_all_families(fitted):
+    app, cfg = fitted
+    _parity_check(app, cfg, 2)
+
+
+@pytest.mark.slow
+def test_parity_eight_replicas_all_families(fitted):
+    app, cfg = fitted
+    _parity_check(app, cfg, 8)
+
+
+# -- epoch-consistent hot-swap ------------------------------------------------
+
+def test_hot_swap_epoch_consistency_under_traffic(fitted):
+    """Re-save a model twice while 6 threads hammer
+    ``predict_with_epoch`` on a 4-replica plane. Per-thread epochs are
+    monotone, every response sharing an epoch carries identical bytes
+    (no mixed-version pair), and the versions observably differ across
+    epochs — so the invariant is tested against real divergence, not
+    identical retrains."""
+    app, cfg = fitted
+    rng = np.random.default_rng(21)
+    n = 150
+    # A SHIFTED distribution: the swapped-in params must move the
+    # answer (re-saving identical seeded params would make the
+    # mixed-version check vacuous).
+    x = rng.normal(loc=2.0, size=n)
+    y = rng.normal(size=n)
+    ds = app.store.create("ptrain2")
+    ds.append_columns({"x": x, "y": y,
+                       "label": (x - 0.5 * y > 2.0).astype(np.int64)})
+    app.store.finish("ptrain2")
+    app.builder.build("ptrain", "ptrain", "hs", ["nb"], "label")
+    app.builder.build("ptrain2", "ptrain2", "hs2", ["nb"], "label")
+    reg = app.builder.registry
+    man1, model1 = reg.load("hs_nb")
+    man2, model2 = reg.load("hs2_nb")
+    pb = _plane(app, cfg, 4)
+    try:
+        pb.predict_probs("hs_nb", [ROW0])   # warm: epoch 1 stamped
+        stop = threading.Event()
+        outs = [[] for _ in range(6)]
+        failures = []
+
+        def reader(out):
+            while not stop.is_set():
+                try:
+                    _, probs, epoch = pb.predict_with_epoch(
+                        "hs_nb", [ROW0])
+                except Exception as exc:  # noqa: BLE001
+                    failures.append(repr(exc))
+                    return
+                out.append((epoch, probs.tobytes()))
+
+        threads = [threading.Thread(target=reader, args=(o,))
+                   for o in outs]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        # Two hot-swaps under sustained traffic (the re-save path the
+        # AOT cache version-keys on): v2 = the shifted-data params,
+        # v3 = the originals back.
+        reg.save("hs_nb", model2, metrics=man2.get("metrics"),
+                 preprocess=man2.get("preprocess"))
+        time.sleep(0.3)
+        reg.save("hs_nb", model1, metrics=man1.get("metrics"),
+                 preprocess=man1.get("preprocess"))
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert not failures, failures[:3]
+        assert all(outs), "a reader thread never completed a request"
+        for out in outs:
+            epochs = [e for e, _ in out]
+            assert epochs == sorted(epochs), "epoch went backwards"
+        by_epoch = {}
+        for e, b in (p for out in outs for p in out):
+            by_epoch.setdefault(e, set()).add(b)
+        mixed = {e: len(s) for e, s in by_epoch.items() if len(s) != 1}
+        assert not mixed, f"mixed-version responses under epochs {mixed}"
+        assert max(by_epoch) >= 3           # cold load + both swaps seen
+        assert len({next(iter(s)) for s in by_epoch.values()}) >= 2, \
+            "swap never changed the answer — the invariant was vacuous"
+        assert pb.aot.snapshot()["swaps"] >= 2
+    finally:
+        pb.stop()
+
+
+# -- replicated residency + exposition ----------------------------------------
+
+def test_device_snapshot_attributes_replicated_params(fitted):
+    """Satellite regression (utils/resources.py): the live-buffer HBM
+    fallback must attribute bytes to EVERY device holding a params
+    replica — before the fix only device 0 ever showed occupancy."""
+    from learningorchestra_tpu.utils import resources
+
+    app, cfg = fitted
+    pb = _plane(app, cfg, 2)
+    try:
+        pb.predict_probs("pm_nb", [ROW0])
+        entry = pb.aot.entry("pm_nb")
+        assert entry.n_replicas == 2
+        assert entry.params_bytes == 2 * entry.params_bytes_per_replica
+        assert pb.aot.snapshot()["params_bytes"] >= entry.params_bytes
+        snap = resources.device_snapshot()
+        assert snap["source"] == "live_buffers"
+        occupied = [d for d in snap["devices"]
+                    if d.get("bytes_in_use", 0) > 0]
+        assert len(occupied) >= 2, snap["devices"]
+        assert snap["total_bytes_in_use"] >= entry.params_bytes
+    finally:
+        pb.stop()
+
+
+def test_replica_prometheus_series(fitted):
+    """Every lo_serving_replica_* series renders one sample per
+    (model, replica) pair straight from the snapshot document."""
+    from learningorchestra_tpu.utils import prometheus
+
+    app, cfg = fitted
+    pb = _plane(app, cfg, 2)
+    try:
+        pb.predict_probs("pm_nb", [ROW0])
+        text = prometheus.render({"serving": pb.snapshot()})
+        for series in ("lo_serving_replica_batches_total",
+                       "lo_serving_replica_batched_rows_total",
+                       "lo_serving_replica_dispatcher_restarts_total",
+                       "lo_serving_replica_queue_rows",
+                       "lo_serving_replica_qps",
+                       "lo_serving_replica_service_us_per_row",
+                       "lo_serving_replica_mean_batch_rows",
+                       "lo_serving_replica_quarantined"):
+            for replica in (0, 1):
+                needle = (f'{series}{{model="pm_nb",'
+                          f'replica="{replica}"}}')
+                assert needle in text, f"missing {needle}"
+        # The AOT topology/footprint counters ride the same document.
+        for needle in ("lo_serving_aot_replicas 2",
+                       "lo_serving_aot_params_bytes",
+                       "lo_serving_aot_swaps"):
+            assert needle in text, f"missing {needle}"
+    finally:
+        pb.stop()

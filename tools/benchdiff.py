@@ -47,6 +47,20 @@ HIGHER_IS_WORSE = ("p50_ms", "p99_ms", "wall_s", "errors", "mismatches",
 LOWER_IS_WORSE = ("rps", "qps", "value", "speedup", "mfu", "bw_util",
                   "answered", "ok")
 
+#: Built-in per-metric tolerances — consulted AFTER any CLI
+#: ``--tolerance`` rules (the caller always wins) and before
+#: ``--default-tolerance``. The replica-sweep throughput/latency figures
+#: are structurally noisy on shared CI rigs (N dispatcher threads
+#: time-slicing few cores), so they gate with generous headroom; their
+#: error/mismatch counters stay pinned exact by the CI
+#: ``--require-equal`` flags, which this table never relaxes.
+BUILTIN_TOLERANCES: List[Tuple[str, float]] = [
+    ("*replica_sweep*rps", 2.0),
+    ("*replica_sweep*p50_ms", 3.0),
+    ("*replica_sweep*p99_ms", 3.0),
+    ("*replica_speedup", 2.0),
+]
+
 
 def normalize(doc: Any, prefix: str = "",
               out: Optional[Dict[str, float]] = None) -> Dict[str, float]:
@@ -98,7 +112,7 @@ def direction(path: str) -> Optional[str]:
 
 def _tolerance_for(path: str, rules: List[Tuple[str, float]],
                    default: float) -> float:
-    for pattern, tol in rules:
+    for pattern, tol in list(rules) + BUILTIN_TOLERANCES:
         if fnmatch.fnmatch(path, pattern):
             return tol
     return default
