@@ -96,6 +96,10 @@ N_SCAN = int(os.environ.get("LO_BENCH_SCAN_ROWS", 4_000_000))
 #: phases separately on the kernel and XLA-oracle paths, so the record
 #: shows where the tree-family speedup lands; 0 skips it.
 N_TREE = int(os.environ.get("LO_BENCH_TREE_ROWS", 4_000_000))
+#: Rows for the peer-replication microbenchmark (PR 17: cross-host data
+#: fault domain) — push throughput to an in-process peer plus a remote
+#: chunk-repair latency smoke; 0 skips it.
+N_REPLICA = int(os.environ.get("LO_BENCH_REPLICA_ROWS", 2_000_000))
 
 
 def scan_bench() -> dict:
@@ -183,6 +187,81 @@ def scan_bench() -> dict:
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def replication_bench() -> dict:
+    """Peer-replication microbenchmark (fault_tolerance.md §9): full-sync
+    push throughput of a committed dataset to an in-process replica
+    peer (the re-replicate leg of the host-loss runbook), and the
+    latency of one remote chunk repair through the ladder's peer rung.
+
+    Loopback sockets, so the figures bound protocol + CRC + fsync cost,
+    not the network — the deltas across commits are what matter."""
+    import shutil
+    import tempfile
+    import numpy as np
+
+    from learningorchestra_tpu.catalog.replicate import ReplicaServer
+    from learningorchestra_tpu.catalog.store import DatasetStore
+    from learningorchestra_tpu.config import Settings
+
+    n = N_REPLICA
+    if n <= 0:
+        return {}
+    tmp = tempfile.mkdtemp(prefix="lo_replica_bench_")
+    peer = ReplicaServer(root=os.path.join(tmp, "peer"), port=0)
+    try:
+        cfg = Settings()
+        cfg.store_root = os.path.join(tmp, "store")
+        cfg.persist = True
+        seed_store = DatasetStore(cfg)          # build WITHOUT peers:
+        ds = seed_store.create("repb")          # pushes don't skew the
+        rng = np.random.default_rng(0)          # ingest timing
+        chunk = 262_144
+        for off in range(0, n, chunk):
+            k = min(chunk, n - off)
+            ds.append_columns({
+                "x1": rng.normal(size=k), "x2": rng.normal(size=k),
+                "y": rng.integers(0, 2, k)})
+            seed_store.save("repb")
+        seed_store.finish("repb")
+
+        cfg.replica_peers = peer.addr
+        store = DatasetStore(cfg)
+        t0 = time.time()
+        store.load_all()                        # recovery re-queues all
+        drained = store.replication_drain(timeout_s=600.0)
+        push_s = time.time() - t0
+        snap = store.replication_snapshot()
+        assert drained and snap["max_lag_bytes"] == 0, snap
+        push_bytes = snap["counters"]["push_bytes"]
+        store.stop_replication()
+
+        # remote repair latency: one chunk lost, healed via the peer
+        chunks_dir = os.path.join(cfg.store_root, "repb", "chunks")
+        victim = sorted(os.listdir(chunks_dir))[0]
+        vbytes = os.path.getsize(os.path.join(chunks_dir, victim))
+        os.remove(os.path.join(chunks_dir, victim))
+        store2 = DatasetStore(cfg)
+        store2.load("repb")
+        t0 = time.time()
+        report = store2.scrub("repb")
+        repair_s = time.time() - t0
+        assert report["ok"] and report["missing"] == 1, report
+        store2.stop_replication()
+        return {
+            "rows": n,
+            "chunks": snap["counters"]["pushes"],
+            "push_mb": round(push_bytes / 1e6, 1),
+            "push_rps": round(n / push_s),
+            "push_mb_s": round(push_bytes / 1e6 / push_s, 1),
+            "repair_chunk_mb": round(vbytes / 1e6, 2),
+            "repair_duration_ms": round(repair_s * 1000.0, 1),
+        }
+    finally:
+        peer.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
 
 def tree_bench() -> dict:
     """Phase-level microbenchmark of the tree-fit hot loops: one level's
@@ -309,6 +388,7 @@ def main() -> None:
 
     scan = scan_bench()
     tree = tree_bench()
+    replication = replication_bench()
     #: Which tree-fit path the sweep below actually runs (config flags +
     #: backend probe) — selects the matching flops/bytes cost model.
     tree_kernel = trees_mod._use_tree_kernel()
@@ -477,6 +557,7 @@ def main() -> None:
         "tree_kernel": tree_kernel,
         "scan_bench": scan,
         "tree_bench": tree,
+        "replication_bench": replication,
     }))
 
 

@@ -732,6 +732,19 @@ class Dataset:
                     pass
             return cur, len(data), data, False
 
+    def journal_size(self) -> tuple:
+        """``(generation, journal_bytes)`` without reading the journal —
+        the O(1) probe the store's replication lag accounting compares
+        against per-peer acked watermarks."""
+        with self._data_lock:
+            size = 0
+            if self._journal_path is not None:
+                try:
+                    size = os.path.getsize(self._journal_path)
+                except OSError:
+                    size = 0
+            return self._gen, size
+
     def journal_files(self) -> List[str]:
         """Basenames of the chunk files the current state references —
         the store's GC/mirror source of truth."""
@@ -835,14 +848,20 @@ class Dataset:
             # deleted-under-us files would read as false corruption.
             self._active_readers += 1
         report: Dict[str, Any] = {"checked": 0, "unchecksummed": 0,
-                                  "errors": []}
+                                  "missing": 0, "errors": []}
         try:
             for c in chunks:
-                if c.crc32 is None and os.path.isfile(c.path):
+                present = os.path.isfile(c.path)
+                if c.crc32 is None and present:
                     # Pre-checksum journal record: existence is all we
                     # can attest.
                     report["unchecksummed"] += 1
                     continue
+                if not present:
+                    # Whole file gone (re-imaged host / deleted chunks
+                    # dir): reported distinctly, and verification below
+                    # still runs so the repair ladder gets its shot.
+                    report["missing"] += 1
                 c._verified = False
                 try:
                     self._verify_chunk(c)

@@ -28,11 +28,12 @@ import os
 import re
 import shutil
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from learningorchestra_tpu.catalog import readpipe
+from learningorchestra_tpu.catalog import readpipe, replicate
 from learningorchestra_tpu.catalog.dataset import (
     ChunkCorrupt, Columns, Dataset, Metadata, _fsync_dir, crc32_file,
     rows_from as _rows_from)
@@ -43,6 +44,7 @@ from learningorchestra_tpu.utils import failpoints
 FP_MIRROR_PRE_COPY = failpoints.declare("store.mirror.pre_copy")
 FP_FINISH_PRE_SAVE = failpoints.declare("store.finish.pre_save")
 FP_SAVE_PRE_META_SWAP = failpoints.declare("store.save.pre_meta_swap")
+FP_REPAIR_PRE_INSTALL = failpoints.declare("store.repair.pre_install")
 
 
 class DatasetNotFound(KeyError):
@@ -160,6 +162,27 @@ class DatasetStore:
         self._integrity_lock = threading.Lock()
         self._integrity = {"chunks_corrupt": 0, "chunks_repaired": 0,
                            "chunks_scrubbed": 0, "scrub_runs": 0}
+        #: Peer replication plane (catalog/replicate.py). _peer_state
+        #: generalizes _mirror_state's (generation, journal-bytes)
+        #: watermark per (peer addr, dataset): acked means the peer has
+        #: committed that exact journal prefix, so journal_bytes - acked
+        #: is the dataset's replication lag — under-replication is
+        #: *known*, not hoped. Pushes run on a single async committer
+        #: thread (same single-slot discipline as ingest's chunk
+        #: committer); failures land in _push_failing and surface via
+        #: replication_snapshot / the data_under_replicated alert.
+        self._peers: List[str] = replicate.parse_peers(
+            self.cfg.replica_peers)
+        self._push_cv = threading.Condition(threading.Lock())
+        self._push_dirty: set = set()
+        self._push_inflight: Optional[str] = None
+        self._push_thread: Optional[threading.Thread] = None
+        self._push_stop = False
+        self._peer_state: Dict[Tuple[str, str], tuple] = {}
+        self._push_failing: Dict[Tuple[str, str], str] = {}
+        self._push_attempt: Dict[str, float] = {}
+        self._repl = {"pushes": 0, "push_bytes": 0, "fetches": 0,
+                      "repairs": 0, "errors": 0}
 
     def _bump(self, key: str, by: int = 1) -> None:
         with self._integrity_lock:
@@ -169,6 +192,21 @@ class DatasetStore:
         """Corruption/repair counters (GET /metrics ``integrity`` block)."""
         with self._integrity_lock:
             return dict(self._integrity)
+
+    def _bump_repl(self, key: str, by: int = 1) -> None:
+        with self._integrity_lock:
+            self._repl[key] = self._repl.get(key, 0) + by
+
+    def _forget_peer_state(self, name: str) -> None:
+        """Drop all replication bookkeeping for a dataset (delete /
+        reopen): the next save starts a fresh full sync."""
+        with self._push_cv:
+            self._push_dirty.discard(name)
+            self._push_attempt.pop(name, None)
+            for key in [k for k in self._peer_state if k[1] == name]:
+                del self._peer_state[key]
+            for key in [k for k in self._push_failing if k[1] == name]:
+                del self._push_failing[key]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -212,6 +250,7 @@ class DatasetStore:
                 raise DatasetNotFound(name)
             del self._datasets[name]
             self._mirror_state.pop(name, None)
+        self._forget_peer_state(name)
         path = self._path(name)
         # Reclaim the dataset's cached chunk reads promptly (keys are
         # CRC-pinned, so this is about bytes, not correctness).
@@ -298,6 +337,7 @@ class DatasetStore:
         with self._lock:
             self._datasets[name] = fresh
             self._mirror_state.pop(name, None)
+        self._forget_peer_state(name)
         if self.cfg.persist:
             self.save(name)
         return fresh
@@ -468,13 +508,58 @@ class DatasetStore:
     def _repair_chunk(self, name: str, fname: str,
                       expected_crc: Optional[int]) -> bool:
         """A chunk file failed verification (checksum mismatch / missing)
-        — the self-healing tier. Counts the detection, then restores the
-        file from the replica mirror when one is configured AND its copy
-        itself verifies (a replica that mirrored the same rot must not
-        'repair' corrupt bytes over corrupt bytes). The restore lands via
-        tmp+rename so a concurrent reader never sees a half-copied file.
-        Returns whether a verified copy was installed."""
+        — the self-healing tier. Counts the detection, then walks the
+        repair ladder: the local replica mirror first (cheap, same
+        host), then a CRC-verified remote fetch from any configured peer
+        holding the dataset — so bit-rot and whole-host loss heal
+        through the same ChunkCorrupt path. Returns whether a verified
+        copy was installed."""
         self._bump("chunks_corrupt")
+        if self._repair_from_mirror(name, fname, expected_crc):
+            return True
+        return self._repair_from_peers(name, fname, expected_crc)
+
+    def _install_repair(self, name: str, fname: str,
+                        src_path: Optional[str] = None,
+                        data: Optional[bytes] = None) -> None:
+        """Land a verified replacement chunk via tmp+rename so a
+        concurrent reader never sees a half-copied file — the shared
+        tail of both repair rungs (``src_path`` from the local mirror,
+        ``data`` fetched from a peer)."""
+        dst_dir = os.path.join(self.cfg.store_root, name, "chunks")
+        os.makedirs(dst_dir, exist_ok=True)
+        dst = os.path.join(dst_dir, fname)
+        tmp = dst + ".repair"
+        if src_path is not None:
+            shutil.copy2(src_path, tmp)
+        else:
+            with open(tmp, "wb") as f:
+                f.write(data or b"")
+                f.flush()
+                os.fsync(f.fileno())
+        # Crash/torn window mid-repair: the corrupt primary (or a torn
+        # .repair tmp) survives and the next read re-enters repair
+        # idempotently.
+        failpoints.fire(FP_REPAIR_PRE_INSTALL, path=tmp)
+        os.replace(tmp, dst)
+        _fsync_dir(dst_dir)
+        # The pre-repair file may have been read (and CACHED) after rot
+        # set in — lazy verification only covers the first read, so such
+        # bytes enter the cache under the journal CRC key. Repair is the
+        # one event that proves the old reads can't be trusted: drop
+        # them so the next read re-decodes the verified replacement.
+        # Both rungs — local mirror AND remote fetch — must pass through
+        # here: a remotely healed file with stale cache entries would
+        # serve the old decoded bytes under the new file's CRC key.
+        readpipe.invalidate_files([dst])
+        self._bump("chunks_repaired")
+
+    def _repair_from_mirror(self, name: str, fname: str,
+                            expected_crc: Optional[int]) -> bool:
+        """Rung 1: restore from the local replica mirror when one is
+        configured AND its copy itself verifies (a replica that mirrored
+        the same rot must not 'repair' corrupt bytes over corrupt
+        bytes)."""
         if not self.cfg.replica_root:
             return False
         src = os.path.join(self.cfg.replica_root, name, "chunks", fname)
@@ -482,21 +567,33 @@ class DatasetStore:
             return False
         if expected_crc is not None and crc32_file(src) != expected_crc:
             return False
-        dst_dir = os.path.join(self.cfg.store_root, name, "chunks")
-        os.makedirs(dst_dir, exist_ok=True)
-        dst = os.path.join(dst_dir, fname)
-        tmp = dst + ".repair"
-        shutil.copy2(src, tmp)
-        os.replace(tmp, dst)
-        _fsync_dir(dst_dir)
-        # The pre-repair file may have been read (and CACHED) after rot
-        # set in — lazy verification only covers the first read, so such
-        # bytes enter the cache under the journal CRC key. Repair is the
-        # one event that proves the old reads can't be trusted: drop
-        # them so the next read re-decodes the verified replica copy.
-        readpipe.invalidate_files([dst])
-        self._bump("chunks_repaired")
+        self._install_repair(name, fname, src_path=src)
         return True
+
+    def _repair_from_peers(self, name: str, fname: str,
+                           expected_crc: Optional[int]) -> bool:
+        """Rung 2: CRC-verified remote fetch from any peer holding the
+        dataset. The client side verifies the received bytes against the
+        journal CRC before anything is installed, and the serving peer
+        re-verifies before replying — corrupt bytes cannot cross the
+        wire in either direction undetected."""
+        if not self._peers:
+            return False
+        for peer in self._peers:
+            try:
+                with replicate.ReplicaClient(
+                        peer, self.cfg.replica_timeout_s) as cli:
+                    data = cli.fetch_chunk(name, fname, expected_crc)
+            except (replicate.ReplicaError, OSError, RuntimeError):
+                # Dead peer / peer without the dataset / mismatched
+                # bytes: count it and try the next rung candidate.
+                self._bump_repl("errors")
+                continue
+            self._bump_repl("fetches")
+            self._install_repair(name, fname, data=data)
+            self._bump_repl("repairs")
+            return True
+        return False
 
     def scrub(self, name: Optional[str] = None) -> Dict[str, Any]:
         """Proactive integrity pass: re-verify every journaled chunk's
@@ -507,12 +604,14 @@ class DatasetStore:
         of the rest. Served at ``POST /catalog/scrub``."""
         names = [name] if name else self.names()
         report: Dict[str, Any] = {"datasets": len(names), "checked": 0,
-                                  "unchecksummed": 0, "errors": {}}
+                                  "unchecksummed": 0, "missing": 0,
+                                  "errors": {}}
         for n in names:
             ds = self.get(n)
             r = ds.scrub_chunks()
             report["checked"] += r["checked"]
             report["unchecksummed"] += r["unchecksummed"]
+            report["missing"] += r.get("missing", 0)
             if r["errors"]:
                 report["errors"][n] = r["errors"]
         self._bump("chunks_scrubbed", report["checked"])
@@ -552,6 +651,8 @@ class DatasetStore:
         ds.maybe_evict()
         if self.cfg.replica_root:
             self._mirror(name)
+        if self._peers:
+            self._queue_push(name)
 
     def _mirror(self, name: str) -> None:
         """Copy the dataset's committed delta to the replica root — the
@@ -644,6 +745,225 @@ class DatasetStore:
             tmp = os.path.join(dst, "metadata.json.tmp")
             shutil.copy2(meta, tmp)
             os.replace(tmp, os.path.join(dst, "metadata.json"))
+
+    # -- peer replication ----------------------------------------------------
+    #
+    # The cross-host generalization of _mirror: each save marks the
+    # dataset dirty and a single committer thread pushes the committed
+    # journal delta to every peer in LO_TPU_REPLICA_PEERS — chunk bytes
+    # first (each hop CRC-verified against the journal record), then the
+    # journal bytes referencing them, so a peer's replica is always a
+    # consistent prefix exactly like the local mirror. A host death
+    # mid-push costs only the unacked suffix.
+
+    def _queue_push(self, name: str) -> None:
+        """Mark a dataset dirty for the push committer (idempotent;
+        concurrent saves of the same dataset coalesce — the push always
+        reads the newest committed journal snapshot)."""
+        with self._push_cv:
+            if self._push_stop:
+                return
+            self._push_dirty.add(name)
+            if self._push_thread is None:
+                # thread-lifecycle: owner=DatasetStore
+                # exit=stop_replication() sets _push_stop and notifies;
+                # the loop returns on the next wake.
+                self._push_thread = threading.Thread(
+                    target=self._push_loop, name="lo-replica-push",
+                    daemon=True)
+                self._push_thread.start()
+            self._push_cv.notify_all()
+
+    def _push_loop(self) -> None:
+        while True:
+            with self._push_cv:
+                while not self._push_dirty and not self._push_stop:
+                    self._push_cv.wait()
+                if self._push_stop:
+                    return
+                name = sorted(self._push_dirty)[0]
+                self._push_dirty.discard(name)
+                self._push_inflight = name
+            try:
+                self._push_dataset(name)
+            finally:
+                with self._push_cv:
+                    self._push_inflight = None
+                    self._push_cv.notify_all()
+
+    def _push_dataset(self, name: str) -> None:
+        """One push cycle: every peer, errors recorded per (peer,
+        dataset) — never raised (replication is asynchronous; the
+        primary's durability does not depend on it)."""
+        with self._push_cv:
+            self._push_attempt[name] = time.monotonic()
+        try:
+            ds = self.get(name)
+        except DatasetNotFound:
+            return  # deleted between save and push
+        for peer in self._peers:
+            key = (peer, name)
+            try:
+                self._push_peer(peer, name, ds)
+            except (replicate.ReplicaError, ChunkCorrupt, OSError,
+                    RuntimeError) as exc:
+                self._bump_repl("errors")
+                with self._push_cv:
+                    self._push_failing[key] = str(exc)
+
+    def _push_peer(self, peer: str, name: str, ds: Dataset) -> None:
+        """Push the committed journal delta for one dataset to one peer.
+        Same snapshot discipline as _mirror: one atomic journal_snapshot
+        names exactly the chunk files to send; files cross the wire
+        before the journal bytes referencing them, each hop CRC-checked
+        on both ends. An offset-mismatch rejection (peer re-imaged or
+        watermark lost) clears the watermark and retries once as a full
+        sync, using scrub_probe to skip bytes the peer already holds."""
+        key = (peer, name)
+        src_chunks = os.path.join(self.cfg.store_root, name, "chunks")
+        for attempt in (0, 1):
+            with self._push_cv:
+                state = self._peer_state.get(key)
+            known_gen, known_off = (state if state is not None
+                                    else (None, 0))
+            gen, size, data, is_delta = ds.journal_snapshot(
+                known_gen, known_off)
+            records = _parse_journal_bytes(data)
+            try:
+                with replicate.ReplicaClient(
+                        peer, self.cfg.replica_timeout_s) as cli:
+                    if is_delta:
+                        need = [r for r in records if r.get("file")]
+                    else:
+                        refs = [(r["file"], r.get("crc32"))
+                                for r in records if r.get("file")]
+                        have = (set(cli.scrub_probe(name, refs))
+                                if refs else set())
+                        need = [r for r in records
+                                if r.get("file") and r["file"] not in have]
+                    for rec in need:
+                        fn = rec["file"]
+                        crc = rec.get("crc32")
+                        path = os.path.join(src_chunks, fn)
+                        actual = (crc32_file(path)
+                                  if os.path.isfile(path) else None)
+                        if crc is not None and actual != crc:
+                            # NEVER push bytes that don't match the
+                            # journal — heal the primary first (mirror
+                            # or another peer) or record the failure.
+                            if not self._repair_chunk(name, fn, crc):
+                                raise ChunkCorrupt(path, crc, actual)
+                        with open(path, "rb") as f:
+                            payload = f.read()
+                        cli.push_chunk(name, fn, crc, payload)
+                        self._bump_repl("pushes")
+                        self._bump_repl("push_bytes", len(payload))
+                    # Metadata rides every sync (a bare `finish` changes
+                    # metadata without appending journal bytes). Routed
+                    # through json default=str like save()'s write.
+                    meta_doc = json.loads(
+                        json.dumps(ds.metadata.to_doc(), default=str))
+                    cli.journal_sync(
+                        name, gen, known_off if is_delta else 0, data,
+                        is_delta, meta_doc)
+            except replicate.ReplicaError as exc:
+                if attempt == 0 and "offset" in str(exc):
+                    with self._push_cv:
+                        self._peer_state.pop(key, None)
+                    continue
+                raise
+            with self._push_cv:
+                self._peer_state[key] = (gen, size)
+                self._push_failing.pop(key, None)
+            return
+
+    def replication_drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until the push committer's queue is empty (every dirty
+        dataset attempted against every peer). Returns False on timeout.
+        Failed pushes still count as drained — their outcome is in
+        replication_snapshot, not an exception."""
+        deadline = time.monotonic() + timeout_s
+        with self._push_cv:
+            while self._push_dirty or self._push_inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._push_cv.wait(left)
+        return True
+
+    def stop_replication(self) -> None:
+        """Stop the push committer thread (serving shutdown)."""
+        with self._push_cv:
+            self._push_stop = True
+            self._push_cv.notify_all()
+            t = self._push_thread
+        if t is not None:
+            t.join(timeout=5)
+
+    def replication_snapshot(self) -> Dict[str, Any]:
+        """Per-dataset replication state for GET /metrics: per-peer
+        acked watermarks, lag bytes, and which datasets are
+        under-replicated (lag with a failed last push — transient lag
+        from an in-flight push is not flagged). Also the read-driven
+        retry tick: datasets whose last attempt failed longer than
+        replica_push_retry_s ago are re-queued, so each scrape advances
+        re-replication until lag clears."""
+        with self._integrity_lock:
+            counters = dict(self._repl)
+        snap: Dict[str, Any] = {"enabled": bool(self._peers),
+                                "peers": list(self._peers),
+                                "counters": counters,
+                                "datasets": {},
+                                "under_replicated": [],
+                                "max_lag_bytes": 0}
+        if not self._peers:
+            return snap
+        now = time.monotonic()
+        with self._push_cv:
+            state = dict(self._peer_state)
+            failing = dict(self._push_failing)
+            dirty = set(self._push_dirty)
+            inflight = self._push_inflight
+            attempts = dict(self._push_attempt)
+        retry: List[str] = []
+        for name in self.names():
+            try:
+                ds = self.get(name)
+            except DatasetNotFound:
+                continue
+            gen, size = ds.journal_size()
+            peers_doc: Dict[str, Any] = {}
+            worst = 0
+            flagged = False
+            for peer in self._peers:
+                st = state.get((peer, name))
+                acked = st[1] if st is not None and st[0] == gen else 0
+                lag = max(0, size - acked)
+                err = failing.get((peer, name))
+                doc: Dict[str, Any] = {"acked_bytes": acked,
+                                       "lag_bytes": lag}
+                if err:
+                    doc["error"] = err
+                peers_doc[peer] = doc
+                pending = name in dirty or inflight == name
+                if lag > 0 and (err or not pending):
+                    worst = max(worst, lag)
+                    flagged = True
+                    snap["under_replicated"].append(
+                        {"dataset": name, "peer": peer,
+                         "lag_bytes": lag})
+            snap["datasets"][name] = {"journal_bytes": size,
+                                      "lag_bytes": worst,
+                                      "peers": peers_doc}
+            snap["max_lag_bytes"] = max(snap["max_lag_bytes"], worst)
+            if flagged and name not in dirty and inflight != name:
+                last = attempts.get(name)
+                if last is None or (now - last
+                                    >= self.cfg.replica_push_retry_s):
+                    retry.append(name)
+        for name in retry:
+            self._queue_push(name)
+        return snap
 
     @staticmethod
     def _read_journal(path: str) -> List[Dict[str, Any]]:
@@ -775,6 +1095,14 @@ class DatasetStore:
                         # one rotten dataset must not abort the whole
                         # recovery scan.
                         pass
+        if self._peers:
+            # Establish fresh acked watermarks: a restarted process has
+            # no push state, so every recovered dataset is re-synced
+            # (scrub_probe keeps the cost at journal bytes + any chunk
+            # bytes the peers actually lack). This is the
+            # "re-replicate" leg of the host-loss runbook.
+            for name in loaded:
+                self._queue_push(name)
         return loaded
 
 

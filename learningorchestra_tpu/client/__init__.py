@@ -505,6 +505,13 @@ class Observability(_ServiceClient):
         recorder bundle id, when one exists."""
         return ResponseTreat.treatment(self.context.get("/alerts"))
 
+    def replication(self) -> Dict:
+        """The cross-host replication plane (``GET /replication``):
+        per-dataset journal lag against each peer's acked watermark,
+        the under-replicated list, push/fetch/repair counters, and the
+        local ReplicaServer's counters when one is running."""
+        return ResponseTreat.treatment(self.context.get("/replication"))
+
     def healthz(self) -> Dict:
         """The deep health rollup. Returns the check document on 200;
         raises on 503 with the FIRING ALERT NAMES in the message — a
@@ -524,10 +531,18 @@ class Observability(_ServiceClient):
                             if isinstance(c, dict) and not c.get("ok"))
             rid = resp.headers.get("X-Request-Id")
             bundle = doc.get("flightrec_latest")
+            # Under-replication names its datasets with their lag: the
+            # operator reading this error knows exactly which data a
+            # host loss would cost, without a second round trip.
+            under = (checks.get("replication") or {}).get(
+                "under_replicated") or []
+            under_msg = "; under-replicated " + ", ".join(
+                f"{u.get('dataset')} ({u.get('lag_bytes')}B behind "
+                f"{u.get('peer')})" for u in under) if under else ""
             raise RuntimeError(
                 "healthz degraded: failing checks "
                 f"{failed or ['unknown']}; firing alerts "
-                f"{firing or ['none']}"
+                f"{firing or ['none']}" + under_msg
                 + (f" [flight recording {bundle}]" if bundle else "")
                 + (f" [request-id {rid}]" if rid else ""))
         return ResponseTreat.treatment(resp)

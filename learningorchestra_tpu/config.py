@@ -62,6 +62,36 @@ class Settings:
     replica_root: str = field(
         default_factory=lambda: _env("LO_TPU_REPLICA_ROOT", "")
     )
+    #: Comma-separated ``host:port`` list of peer replica servers
+    #: (catalog/replicate.py). Each committed journal prefix is pushed to
+    #: every peer by an async single-slot committer; `_repair_chunk` adds
+    #: a CRC-verified remote fetch rung so reads heal whole-host loss
+    #: through the same ChunkCorrupt path as local bit-rot. Empty (the
+    #: default) keeps replica_root-only behavior byte-for-byte unchanged.
+    replica_peers: str = field(
+        default_factory=lambda: _env("LO_TPU_REPLICA_PEERS", "")
+    )
+    #: Port for this host's ReplicaServer (the receive side of the
+    #: replication plane). 0 (default) does not start one — set it on
+    #: every host that should hold peers' replicas. Bound on
+    #: LO_TPU_HOST.
+    replica_port: int = field(
+        default_factory=lambda: _env("LO_TPU_REPLICA_PORT", 0)
+    )
+    #: Socket timeout, seconds, for every replication frame exchange
+    #: (push, fetch, probe). A dead peer costs at most this long per
+    #: attempt before the push is recorded as failed and the dataset
+    #: counted under-replicated.
+    replica_timeout_s: float = field(
+        default_factory=lambda: _env("LO_TPU_REPLICA_TIMEOUT_S", 10.0)
+    )
+    #: Minimum seconds between re-push attempts for an under-replicated
+    #: dataset. Failed pushes leave the dataset on the push queue's
+    #: retry list; each /metrics scrape (or replication_snapshot call)
+    #: re-queues datasets whose last attempt is older than this.
+    replica_push_retry_s: float = field(
+        default_factory=lambda: _env("LO_TPU_REPLICA_PUSH_RETRY_S", 2.0)
+    )
     #: Chunks read ahead of the consumer by the prefetching read pipeline
     #: (catalog/readpipe.py): while a streaming consumer (iter_chunks /
     #: snapshot scans) computes on chunk i, a background worker pool

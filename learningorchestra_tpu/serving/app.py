@@ -126,6 +126,11 @@ class App:
         #: its worker/channel counters feed ``/metrics`` (``frontend``
         #: section → ``lo_frontend_*``) and the health rollup.
         self._frontend = None
+        #: This host's ReplicaServer (catalog/replicate.py) when
+        #: ``LO_TPU_REPLICA_PORT`` is set, started by :meth:`serve` —
+        #: its push/fetch counters ride the ``replication`` metrics
+        #: section.
+        self._replica_server = None
         self.router = Router()
         self._register()
         if recover and self.cfg.persist:
@@ -655,6 +660,18 @@ class App:
             doc["flightrec_latest"] = app.flightrec.latest()
             return 200, doc
 
+        @self._route("GET", "/replication")
+        def replication_view(_req):
+            # The replication section of /metrics, standalone (the
+            # client SDK's Observability.replication() passthrough):
+            # per-dataset lag against each peer's acked watermark, the
+            # under-replicated list, push/fetch/repair counters. Reading
+            # it ticks the push committer's retry check like a scrape.
+            doc = app.store.replication_snapshot()
+            if app._replica_server is not None:
+                doc["server"] = app._replica_server.snapshot()
+            return 200, doc
+
         @self._route("GET", "/healthz")
         def healthz(_req):
             doc = app._health_doc()
@@ -756,7 +773,15 @@ class App:
                "compile": resources.compile_snapshot(),
                "pod": {"error": pod_error,
                        "degraded": pod_error is not None},
-               "profile_dir": self.cfg.profile_dir or None}
+               "profile_dir": self.cfg.profile_dir or None,
+               # Cross-host replication plane: per-dataset lag against
+               # each peer's acked watermark, push/fetch/repair
+               # counters, and the under-replicated list the
+               # data_under_replicated alert and /healthz check read.
+               # Snapshotting doubles as the read-driven retry tick.
+               "replication": self.store.replication_snapshot()}
+        if self._replica_server is not None:
+            doc["replication"]["server"] = self._replica_server.snapshot()
         if self._frontend is not None:
             # Multi-worker topology only: accept-process liveness,
             # respawn accounting and row-channel frame counters
@@ -818,6 +843,19 @@ class App:
                 "workers": fr.get("workers"),
                 "workers_alive": fr.get("workers_alive"),
                 "slots_abandoned": fr.get("slots_abandoned"),
+            }
+        rep = mdoc.get("replication") or {}
+        if rep.get("enabled"):
+            # Peer topology only (check absent otherwise, so single-host
+            # deployments keep their healthz schema): a host that cannot
+            # replicate committed data is a durability incident — depool
+            # it and let the runbook's re-replicate leg clear the lag.
+            under = rep.get("under_replicated") or []
+            checks["replication"] = {
+                "ok": not under,
+                "peers": rep.get("peers"),
+                "max_lag_bytes": rep.get("max_lag_bytes"),
+                "under_replicated": under,
             }
         return {"healthy": all(c["ok"] for c in checks.values()),
                 "state": "draining" if draining else "serving",
@@ -1020,6 +1058,27 @@ class App:
         # (queued requests fail fast instead of waiting out their
         # timeout against a dead worker).
         server.on_stop(self.predictor.stop)
+        if int(self.cfg.replica_port) > 0:
+            # This host's receive side of the replication plane: peers
+            # push journal prefixes here and fetch chunks back out for
+            # remote repair. Writes land under replica_root (or
+            # <store_root>/_replicas), the same layout the local-mirror
+            # restore path already reads; fetches also consult the
+            # primary store_root so peers can heal from datasets this
+            # host natively owns.
+            from learningorchestra_tpu.catalog import replicate
+
+            self._replica_server = replicate.ReplicaServer(
+                root=(self.cfg.replica_root
+                      or os.path.join(self.cfg.store_root, "_replicas")),
+                host=self.cfg.host, port=int(self.cfg.replica_port),
+                extra_roots=(self.cfg.store_root,),
+                timeout_s=self.cfg.replica_timeout_s)
+            server.on_stop(self._replica_server.stop)
+        # The push committer (if peers are configured) dies with the
+        # server so a drain never strands a half-pushed journal suffix
+        # silently — the watermark keeps it resumable on restart.
+        server.on_stop(self.store.stop_replication)
         # The telemetry sampler lives exactly as long as the server:
         # started here (bare App construction spawns no threads — tests
         # drive history via reads), stopped with it — and the stop

@@ -440,6 +440,20 @@ def _disk_free(snapshot: Dict[str, Any],
     return _path(snapshot, "resources", "disk", "free_bytes")
 
 
+def _under_replicated(snapshot: Dict[str, Any],
+                      _state: Dict[str, Any]) -> Optional[float]:
+    """(dataset, peer) pairs with committed-but-unacked journal bytes
+    whose last push FAILED — the store does not flag transient lag from
+    an in-flight push, so this level is burn-rate friendly: it holds
+    through a real outage and drops to zero the moment re-replication
+    catches up. None (rule skips the window) when no peers are
+    configured."""
+    rep = snapshot.get("replication") or {}
+    if not rep.get("enabled"):
+        return None
+    return float(len(rep.get("under_replicated") or []))
+
+
 def default_rules(cfg: Settings, history=None) -> List[AlertRule]:
     """The shipped rule table (docs/observability.md). Thresholds come
     from Settings; a 0 threshold knob drops its rule entirely. With a
@@ -544,6 +558,14 @@ def default_rules(cfg: Settings, history=None) -> List[AlertRule]:
                 "on read or scrub)",
         sample=counter_delta("integrity", "chunks_corrupt"),
         threshold=0.0, for_windows=1))
+    rules.append(AlertRule(
+        name="data_under_replicated", severity="critical",
+        summary="committed journal bytes are not replicated to every "
+                "peer and the last push failed — a host loss right now "
+                "loses the unacked suffix; check peer liveness, lag "
+                "drains automatically once a push succeeds "
+                "(docs/fault_tolerance.md §9)",
+        sample=_under_replicated, threshold=0.0, for_windows=1))
     rules.append(AlertRule(
         name="readpipe_worker_errors", severity="warning",
         summary="chunk-read pipeline workers raised this window "

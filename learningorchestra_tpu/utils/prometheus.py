@@ -174,6 +174,29 @@ def render(doc: Dict[str, Any]) -> str:
         if sec:
             _flat_counters(w, prefix, sec, mtype, help_text)
 
+    rep = doc.get("replication") or {}
+    if rep.get("enabled"):
+        for key in ("pushes", "push_bytes", "fetches", "repairs",
+                    "errors"):
+            name = f"lo_replica_{key}_total"
+            w.header(name, _COUNTER,
+                     f"Peer replication plane {key} this process")
+            w.sample(name, None, (rep.get("counters") or {}).get(key, 0))
+        w.header("lo_replica_lag_bytes", _GAUGE,
+                 "Journal bytes committed locally but not yet acked by "
+                 "the worst-lagging peer, per dataset")
+        for dname, d in sorted((rep.get("datasets") or {}).items()):
+            w.sample("lo_replica_lag_bytes", {"dataset": dname},
+                     d.get("lag_bytes", 0))
+        w.header("lo_replica_under_replicated", _GAUGE,
+                 "(dataset, peer) pairs with replication lag and a "
+                 "failed last push")
+        w.sample("lo_replica_under_replicated", None,
+                 len(rep.get("under_replicated") or []))
+        w.header("lo_replica_peers", _GAUGE,
+                 "Configured peer replica targets")
+        w.sample("lo_replica_peers", None, len(rep.get("peers") or []))
+
     serving = doc.get("serving") or {}
     models = serving.get("models") or {}
     if models:

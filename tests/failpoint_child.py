@@ -82,5 +82,42 @@ fctx.save(2, {"feat": np.arange(8, dtype=np.int32)})
 loaded = fctx.load()
 assert loaded is not None and loaded[0] == 2, loaded
 
+# -- 5. peer replication: push, drain, host-level loss, remote repair ---------
+# Runs against a THROWAWAY second store (root/repstore) with an
+# in-process peer (root/peer) so a crash mid-repair never taints the
+# primary store the parent recovers. Hits, in order:
+# replicate.push.pre_send, replicate.serve.pre_reply (first wire
+# exchange), replicate.serve.pre_commit (chunk install on the peer),
+# replicate.push.mid_stream (journal sync) — then, after deleting a
+# committed primary chunk, replicate.fetch.pre_read and
+# store.repair.pre_install (the remote rung of the repair ladder).
+from learningorchestra_tpu.catalog.replicate import ReplicaServer  # noqa: E402
+
+peer = ReplicaServer(root=os.path.join(root, "peer"), port=0)
+rcfg = Settings()
+rcfg.store_root = os.path.join(root, "repstore")
+rcfg.replica_root = ""        # no local mirror: repair MUST go remote
+rcfg.persist = True
+rcfg.replica_peers = f"{peer.host}:{peer.port}"
+rstore = DatasetStore(rcfg)
+rstore.create("rep", columns={"x": np.arange(256, dtype=np.int64)})
+rstore.save("rep")
+rstore.finish("rep")
+assert rstore.replication_drain(timeout_s=60.0)
+rsnap = rstore.replication_snapshot()
+assert rsnap["max_lag_bytes"] == 0, rsnap
+rstore.stop_replication()
+
+# host-level loss of a committed chunk: heal through the peer
+rchunks = os.path.join(rcfg.store_root, "rep", "chunks")
+victim = sorted(os.listdir(rchunks))[0]
+os.remove(os.path.join(rchunks, victim))
+rstore2 = DatasetStore(rcfg)
+rx = rstore2.load("rep").column("x")
+assert len(rx) == 256 and int(rx[255]) == 255, len(rx)
+assert rstore2.integrity_snapshot()["chunks_repaired"] >= 1
+rstore2.stop_replication()
+peer.stop()
+
 with open(os.path.join(root, "done.json"), "w") as f:
-    json.dump({"ing_rows": n_ing, "tab_rows": n_tab}, f)
+    json.dump({"ing_rows": n_ing, "tab_rows": n_tab, "rep_rows": len(rx)}, f)

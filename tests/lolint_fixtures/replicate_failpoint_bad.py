@@ -1,0 +1,15 @@
+"""FIRING fixture for failpoint-coverage's catalog/replicate.py scope:
+socket send seams of the replication plane the peer-loss chaos sweep
+cannot kill or tear without a registered site."""
+
+
+class Client:
+    _sock = None
+
+    def push(self, frame):
+        self._sock.sendall(frame)       # push hop, no fire() seam
+
+
+class Server:
+    def reply(self, conn, frame):
+        conn.sendall(frame)             # reply hop, no fire() seam

@@ -1,0 +1,31 @@
+"""NON-FIRING fixture for failpoint-coverage's catalog/replicate.py
+scope: every socket send seam carries a declared site, and calls that
+merely end in the trigger's characters are not seams."""
+
+from learningorchestra_tpu.utils import failpoints
+
+FP_PRE_SEND = failpoints.declare("test.fixture.replicate.pre_send")
+FP_PRE_REPLY = failpoints.declare("test.fixture.replicate.pre_reply")
+
+
+class Client:
+    _sock = None
+
+    def push(self, frame):
+        failpoints.fire(FP_PRE_SEND)
+        self._sock.sendall(frame)
+
+
+class Server:
+    def reply(self, conn, frame):
+        failpoints.fire(FP_PRE_REPLY)
+        conn.sendall(frame)
+
+
+class Lookalike:
+    resendall = None
+
+    def no_seam(self, frame):
+        # Attribute-boundary check: `x.resendall` merely ENDS in the
+        # trigger's characters — not a send seam.
+        return self.resendall(frame)

@@ -157,6 +157,43 @@ def test_smoke_raise_mode_through_a_real_chunk_write(tmp_path):
     assert store2.scrub("smoke")["ok"]
 
 
+def test_smoke_raise_mode_through_replication_push(tmp_path):
+    """Tier-1 smoke for the replicate.* sites: a raise-mode failpoint at
+    the push seam fails the async push WITHOUT failing the save, the
+    dataset surfaces as under-replicated, and the read-driven retry tick
+    (a later snapshot) drains the lag back to zero."""
+    from learningorchestra_tpu.catalog.replicate import ReplicaServer
+
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, replica=False)
+    cfg.replica_peers = f"{peer.host}:{peer.port}"
+    cfg.replica_push_retry_s = 0.0
+    store = DatasetStore(cfg)
+    try:
+        # persistent (nth=0): every push attempt fails until disarm —
+        # create and save may schedule separate push attempts
+        failpoints.configure("replicate.push.pre_send=raise:0")
+        store.create("d", columns={"x": np.arange(64, dtype=np.int64)})
+        store.save("d")                  # push is async: save unaffected
+        assert store.replication_drain(timeout_s=30.0)
+        snap = store.replication_snapshot()
+        assert snap["counters"]["errors"] >= 1
+        assert snap["under_replicated"], snap
+        # disarm; each snapshot is a retry tick (retry_s=0) — the next
+        # push heals the lag (loop absorbs a pre-disarm in-flight retry)
+        failpoints.reset()
+        for _ in range(10):
+            store.replication_snapshot()
+            assert store.replication_drain(timeout_s=30.0)
+            snap = store.replication_snapshot()
+            if snap["max_lag_bytes"] == 0:
+                break
+        assert snap["max_lag_bytes"] == 0 and not snap["under_replicated"]
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
 # -- 2. checksum detection / self-healing -------------------------------------
 
 def _seed_mirrored(cfg, rows: int = 50):
@@ -384,7 +421,9 @@ def test_journal_truncation_recovers_to_prefix_at_every_byte(tmp_path):
 
 def _run_child(root: str, env_extra: dict) -> subprocess.CompletedProcess:
     env = dict(os.environ, **env_extra)
-    env.pop("LO_TPU_REPLICA_ROOT", None)
+    for var in ("LO_TPU_REPLICA_ROOT", "LO_TPU_REPLICA_PEERS",
+                "LO_TPU_REPLICA_PORT"):
+        env.pop(var, None)
     return subprocess.run([sys.executable, CHILD, root],
                           capture_output=True, text=True, timeout=120,
                           env=env)
@@ -392,11 +431,13 @@ def _run_child(root: str, env_extra: dict) -> subprocess.CompletedProcess:
 
 def _sweep_sites():
     # Import for the side effect of declaring every data-plane site
-    # (the fit-checkpoint store's write/read windows included).
+    # (the fit-checkpoint store's write/read windows and the peer
+    # replication plane's wire seams included).
     import learningorchestra_tpu.catalog.ingest  # noqa: F401
     import learningorchestra_tpu.utils.fitckpt  # noqa: F401
     return [s for s in failpoints.sites()
-            if s.startswith(("catalog.", "ingest.", "store.", "fit."))
+            if s.startswith(("catalog.", "ingest.", "store.", "fit.",
+                             "replicate."))
             and not s.startswith("test.")]
 
 
@@ -420,6 +461,36 @@ def _assert_fitckpt_recovered(cfg, site):
             arrays["feat"], np.arange(4 * progress, dtype=np.int32))
 
 
+def _assert_peer_replica_consistent(root):
+    """Post-crash invariant for the child's in-process replica peer:
+    whatever journal prefix the peer holds (torn tail tolerated), every
+    chunk that prefix references is present and CRC-matches — the peer
+    never committed journal bytes whose chunks it didn't verify, so a
+    re-imaged primary recovering FROM this peer lands on the acked
+    watermark with green checksums."""
+    peer_root = os.path.join(root, "peer")
+    if not os.path.isdir(peer_root):
+        return                          # crash before the peer existed
+    for name in os.listdir(peer_root):
+        jpath = os.path.join(peer_root, name, "journal.jsonl")
+        if not os.path.isfile(jpath):
+            continue
+        with open(jpath, "rb") as f:
+            data = f.read()
+        for line in data.split(b"\n"):
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue                # torn tail: not durable, ignored
+            if "crc32" not in rec or "file" not in rec:
+                continue
+            path = os.path.join(peer_root, name, "chunks", rec["file"])
+            assert os.path.isfile(path), (name, rec["file"])
+            assert crc32_file(path) == rec["crc32"], (name, rec["file"])
+
+
 def test_control_child_completes(tmp_path):
     """No failpoint armed: the sweep workload itself is sound and
     traverses to completion (guards the sweep against vacuous passes)."""
@@ -430,6 +501,8 @@ def test_control_child_completes(tmp_path):
     with open(os.path.join(root, "done.json")) as f:
         done = json.load(f)
     assert done["tab_rows"] == 200 and done["ing_rows"] == 2000
+    assert done["rep_rows"] == 256   # remote repair healed the chunk loss
+    _assert_peer_replica_consistent(root)
 
 
 @pytest.mark.slow
@@ -480,6 +553,11 @@ def test_crash_sweep_recovers_to_journaled_prefix(tmp_path, site):
     store.save("post")
     assert store.scrub("post")["ok"]
     _assert_fitckpt_recovered(cfg, site)
+    # replication-plane invariant: the peer only ever holds a journal
+    # prefix whose referenced chunks verify (recovery to the acked
+    # watermark) — checked for every site; the replicate.* / repair
+    # crashes are the ones that exercise it non-vacuously.
+    _assert_peer_replica_consistent(root)
     shutil.rmtree(root, ignore_errors=True)
 
 

@@ -118,6 +118,37 @@ def test_failpoint_coverage_serving_scope():
     assert list(rule.check(cat)) == []
 
 
+def test_failpoint_coverage_replicate_scope():
+    """The rule's catalog/replicate.py extension: socket send seams
+    (``sendall``) must carry a fire() site — the hops the peer-loss
+    chaos sweep kills/tears mid-push (PR 17). The trigger applies to
+    that one file only, and attribute boundaries hold."""
+    (rule,) = rules_by_name(["failpoint-coverage"])
+    relpath = "learningorchestra_tpu/catalog/replicate.py"
+    assert rule.applies(relpath)
+
+    bad = parse_source(_fixture("replicate_failpoint", "bad"), relpath)
+    finds = list(rule.check(bad))
+    msgs = "\n".join(f.message for f in finds)
+    assert len(finds) == 2, finds
+    assert "sendall()" in msgs
+    assert "replication send/commit seam" in msgs
+
+    good = parse_source(_fixture("replicate_failpoint", "good"), relpath)
+    assert list(rule.check(good)) == []
+
+    # Other catalog files calling sendall are NOT replication seams —
+    # the trigger is scoped to replicate.py exactly.
+    other = parse_source(_fixture("replicate_failpoint", "bad"),
+                         "learningorchestra_tpu/catalog/store.py")
+    assert list(rule.check(other)) == []
+    # And the same source under serving/ scope is also clean: sendall
+    # is not a serving trigger.
+    srv = parse_source(_fixture("replicate_failpoint", "bad"),
+                       "learningorchestra_tpu/serving/fx.py")
+    assert list(rule.check(srv)) == []
+
+
 # -- finalize (whole-project) passes -----------------------------------------
 
 def _project_with(tmp_path, relpath, source):

@@ -1,0 +1,605 @@
+"""Cross-host data fault domain: peer-replicated chunk store (PR 17).
+
+Four layers of proof:
+
+1. wire: the replication frames (push_chunk / journal_sync / fetch_chunk
+   / scrub_probe) roundtrip with CRC32 verified on BOTH ends of every
+   hop — a corrupt payload is refused on push, never served on fetch,
+   and hostile dataset names never escape the peer's root;
+2. watermarks: per-peer acked (generation, journal-bytes) state drives
+   the under-replication surface — transient in-flight lag is not
+   flagged, a failed push is, and the read-driven retry tick re-drains
+   the lag once the peer returns;
+3. repair: the remote rung of the repair ladder heals chunk loss
+   through the exact same ChunkCorrupt path as local-mirror repair,
+   including readpipe cache invalidation (satellite 1) and scrub over a
+   wholly-missing chunks dir (satellite 2);
+4. chaos (slow): the host-loss headline — delete EVERY primary chunk of
+   a committed dataset and scan it back bit-identically through remote
+   repair, and kill the peer mid-push then watch the
+   ``data_under_replicated`` alert fire during the outage and resolve
+   after re-replication to a restarted peer on the same port.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.catalog import readpipe
+from learningorchestra_tpu.catalog.dataset import ChunkCorrupt, crc32_file
+from learningorchestra_tpu.catalog.replicate import (
+    ReplicaClient, ReplicaError, ReplicaServer, parse_peers)
+from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.utils import alerts, failpoints, prometheus
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    failpoints.reset()
+    readpipe.reset()
+    yield
+    failpoints.reset()
+    readpipe.reset()
+
+
+def _mk_cfg(tmp_path, peers: str = "", mirror: bool = False) -> Settings:
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.replica_root = str(tmp_path / "replica") if mirror else ""
+    cfg.persist = True
+    cfg.replica_peers = peers
+    cfg.replica_push_retry_s = 0.0   # every snapshot is a retry tick
+    return cfg
+
+
+def _seed(store: DatasetStore, name: str = "d", n_chunks: int = 3,
+          rows: int = 200) -> np.ndarray:
+    """A finished dataset with ``n_chunks`` journaled chunks; returns
+    the expected column for bit-identity checks."""
+    ds = store.create(name)
+    for i in range(n_chunks):
+        ds.append_columns({"x": np.arange(i * rows, (i + 1) * rows,
+                                          dtype=np.int64)})
+        store.save(name)
+    store.finish(name)
+    return np.arange(n_chunks * rows, dtype=np.int64)
+
+
+def _drain_lag(store: DatasetStore, attempts: int = 20) -> dict:
+    """Snapshot (= retry tick) + drain until the lag clears or the
+    attempt budget runs out; returns the final snapshot."""
+    snap = store.replication_snapshot()
+    for _ in range(attempts):
+        assert store.replication_drain(timeout_s=30.0)
+        snap = store.replication_snapshot()
+        if snap["max_lag_bytes"] == 0 and not snap["under_replicated"]:
+            break
+    return snap
+
+
+# -- 1. wire protocol ---------------------------------------------------------
+
+def test_parse_peers():
+    assert parse_peers("") == []
+    assert parse_peers("  ") == []
+    assert parse_peers("h1:7401, h2:7401 ,h3:9") == [
+        "h1:7401", "h2:7401", "h3:9"]
+    with pytest.raises(ValueError, match="host:port"):
+        parse_peers("h1:7401,justahost")
+
+
+def test_push_fetch_probe_roundtrip(tmp_path):
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    data = os.urandom(4096)
+    crc = __import__("zlib").crc32(data) & 0xFFFFFFFF
+    try:
+        with ReplicaClient(peer.addr) as c:
+            c.push_chunk("d", "g0_c0.bin", crc, data)
+            assert c.scrub_probe("d", [("g0_c0.bin", crc),
+                                       ("g0_c9.bin", 1)]) == ["g0_c0.bin"]
+            assert c.fetch_chunk("d", "g0_c0.bin", crc) == data
+            with pytest.raises(ReplicaError):
+                c.fetch_chunk("d", "nope.bin", crc)
+        counters = peer.snapshot()["counters"]
+        assert counters["pushes"] == 1 and counters["fetches"] == 1
+        assert counters["probes"] == 1
+    finally:
+        peer.stop()
+
+
+def test_push_with_corrupt_payload_is_refused(tmp_path):
+    """The peer CRCs every pushed payload against the journal CRC in the
+    header before committing — it never ACCEPTS bytes that don't match
+    the journal."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    try:
+        with ReplicaClient(peer.addr) as c:
+            with pytest.raises(ReplicaError, match="crc"):
+                c.push_chunk("d", "g0_c0.bin", 12345, b"not those bytes")
+        assert not os.path.exists(
+            os.path.join(str(tmp_path / "peer"), "d", "chunks",
+                         "g0_c0.bin"))
+        assert peer.snapshot()["counters"]["errors"] == 1
+    finally:
+        peer.stop()
+
+
+def test_fetch_never_serves_rotted_bytes(tmp_path):
+    """The peer re-CRCs its own copy before serving — it never SERVES
+    bytes that don't match the journal, so repair can't launder rot
+    from one host to another."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    data = b"x" * 2048
+    crc = __import__("zlib").crc32(data) & 0xFFFFFFFF
+    try:
+        with ReplicaClient(peer.addr) as c:
+            c.push_chunk("d", "g0_c0.bin", crc, data)
+        path = os.path.join(str(tmp_path / "peer"), "d", "chunks",
+                            "g0_c0.bin")
+        with open(path, "r+b") as f:      # rot the peer's copy
+            f.seek(100)
+            f.write(b"\xff")
+        with ReplicaClient(peer.addr) as c:
+            with pytest.raises(ReplicaError):
+                c.fetch_chunk("d", "g0_c0.bin", crc)
+    finally:
+        peer.stop()
+
+
+def test_journal_sync_delta_full_and_offset_mismatch(tmp_path):
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    rec = json.dumps({"file": "g0_c0.bin", "rows": 1}).encode() + b"\n"
+    rec2 = json.dumps({"file": "g0_c1.bin", "rows": 1}).encode() + b"\n"
+    try:
+        crc_b = __import__("zlib").crc32(b"b") & 0xFFFFFFFF
+        with ReplicaClient(peer.addr) as c:
+            # chunks referenced by a journal must land first
+            c.push_chunk("d", "g0_c0.bin", crc_b, b"b")
+            size = c.journal_sync("d", 0, 0, rec, is_delta=False)
+            assert size == len(rec)
+            # a full sync GCs files its journal doesn't reference, so the
+            # delta's chunk is pushed after it — exactly the committer's
+            # chunks-before-journal discipline
+            c.push_chunk("d", "g0_c1.bin", crc_b, b"b")
+            size = c.journal_sync("d", 0, len(rec), rec2, is_delta=True)
+            assert size == len(rec) + len(rec2)
+            # stale watermark: delta from the wrong offset is refused —
+            # the client reacts by clearing the watermark + full resync
+            with pytest.raises(ReplicaError, match="offset"):
+                c.journal_sync("d", 0, 7, rec2, is_delta=True)
+    finally:
+        peer.stop()
+
+
+def test_hostile_dataset_names_rejected(tmp_path):
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    try:
+        with ReplicaClient(peer.addr) as c:
+            with pytest.raises(ReplicaError):
+                c.fetch_chunk("../escape", "g0_c0.bin", 1)
+        with ReplicaClient(peer.addr) as c:
+            with pytest.raises(ReplicaError):
+                c.push_chunk("d", "../../etc/passwd", 1, b"x")
+    finally:
+        peer.stop()
+
+
+# -- 2. watermarks + under-replication ----------------------------------------
+
+def test_push_acks_advance_the_watermark(tmp_path):
+    """A drained push leaves the per-peer acked watermark equal to the
+    journal size — and the peer holds a byte-identical journal whose
+    chunks CRC-verify."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, peers=peer.addr)
+    store = DatasetStore(cfg)
+    try:
+        _seed(store, "d", n_chunks=2)
+        assert store.replication_drain(timeout_s=30.0)
+        snap = store.replication_snapshot()
+        doc = snap["datasets"]["d"]
+        assert doc["lag_bytes"] == 0
+        assert doc["peers"][peer.addr]["acked_bytes"] == \
+            doc["journal_bytes"] > 0
+        with open(os.path.join(cfg.store_root, "d",
+                               "journal.jsonl"), "rb") as f:
+            primary = f.read()
+        with open(os.path.join(str(tmp_path / "peer"), "d",
+                               "journal.jsonl"), "rb") as f:
+            assert f.read() == primary
+        for rec in (json.loads(ln) for ln in primary.splitlines()):
+            p = os.path.join(str(tmp_path / "peer"), "d", "chunks",
+                             rec["file"])
+            assert crc32_file(p) == rec["crc32"]
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+def test_no_peers_means_replication_disabled_and_local_mirror_intact(
+        tmp_path):
+    """LO_TPU_REPLICA_PEERS unset: the snapshot says disabled, no push
+    thread spins up, and the local replica_root mirror behaves exactly
+    as before (the byte-for-byte compatibility clause)."""
+    cfg = _mk_cfg(tmp_path, peers="", mirror=True)
+    store = DatasetStore(cfg)
+    want = _seed(store, "d", n_chunks=2)
+    snap = store.replication_snapshot()
+    assert snap == {"enabled": False, "peers": [], "counters":
+                    snap["counters"], "datasets": {},
+                    "under_replicated": [], "max_lag_bytes": 0}
+    assert store._push_thread is None
+    # the mirror still heals: delete a primary chunk, read heals locally
+    chunks = os.path.join(cfg.store_root, "d", "chunks")
+    os.remove(os.path.join(chunks, sorted(os.listdir(chunks))[0]))
+    store2 = DatasetStore(cfg)
+    np.testing.assert_array_equal(store2.load("d").column("x"), want)
+    assert store2.replication_snapshot()["counters"]["fetches"] == 0
+
+
+def test_peer_outage_flags_under_replication_and_restart_heals(tmp_path):
+    """Peer down at push time: the dataset surfaces as under-replicated
+    with the error recorded; a peer restarted on the SAME port plus the
+    read-driven retry tick drains the lag without any explicit resync
+    call."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    addr, port = peer.addr, peer.port
+    cfg = _mk_cfg(tmp_path, peers=addr)
+    store = DatasetStore(cfg)
+    try:
+        peer.stop()                               # outage BEFORE the push
+        _seed(store, "d", n_chunks=2)
+        assert store.replication_drain(timeout_s=30.0)
+        snap = store.replication_snapshot()
+        assert snap["under_replicated"], snap
+        assert snap["under_replicated"][0]["dataset"] == "d"
+        assert snap["under_replicated"][0]["lag_bytes"] > 0
+        assert "error" in snap["datasets"]["d"]["peers"][addr]
+        peer = ReplicaServer(root=str(tmp_path / "peer"), port=port)
+        snap = _drain_lag(store)
+        assert snap["max_lag_bytes"] == 0 and not snap["under_replicated"]
+        assert snap["counters"]["pushes"] >= 2
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+def test_load_all_requeues_replication(tmp_path):
+    """The re-replicate leg of the host-loss runbook: a store recovered
+    via load_all re-queues every dataset, so a re-imaged peer converges
+    without waiting for new writes."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, peers=peer.addr)
+    store = DatasetStore(cfg)
+    try:
+        _seed(store, "d", n_chunks=2)
+        assert store.replication_drain(timeout_s=30.0)
+        store.stop_replication()
+        shutil.rmtree(str(tmp_path / "peer"))     # re-imaged peer: empty
+        peer.stop()
+        peer = ReplicaServer(root=str(tmp_path / "peer"), port=peer.port)
+        store2 = DatasetStore(cfg)
+        store2.load_all()
+        snap = _drain_lag(store2)
+        assert snap["max_lag_bytes"] == 0
+        assert os.path.isfile(os.path.join(str(tmp_path / "peer"), "d",
+                                           "journal.jsonl"))
+        store2.stop_replication()
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+# -- 3. the remote repair rung ------------------------------------------------
+
+def test_remote_repair_heals_missing_chunk(tmp_path):
+    """Chunk loss with NO local mirror: the repair ladder's second rung
+    fetches the CRC-verified copy from a peer through the same
+    ChunkCorrupt path as mirror repair."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, peers=peer.addr)
+    store = DatasetStore(cfg)
+    try:
+        want = _seed(store, "d", n_chunks=2)
+        assert store.replication_drain(timeout_s=30.0)
+        chunks = os.path.join(cfg.store_root, "d", "chunks")
+        os.remove(os.path.join(chunks, sorted(os.listdir(chunks))[0]))
+        store2 = DatasetStore(cfg)
+        np.testing.assert_array_equal(store2.load("d").column("x"), want)
+        snap = store2.integrity_snapshot()
+        assert snap["chunks_corrupt"] == 1 and snap["chunks_repaired"] == 1
+        assert store2.replication_snapshot()["counters"]["fetches"] == 1
+        assert store2.replication_snapshot()["counters"]["repairs"] == 1
+        store2.stop_replication()
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+def test_remote_repair_failure_surfaces_chunk_corrupt(tmp_path):
+    """No mirror AND the peer fetch fails (raise-mode failpoint): the
+    read surfaces the original precise ChunkCorrupt, not a replication
+    traceback."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, peers=peer.addr)
+    store = DatasetStore(cfg)
+    try:
+        _seed(store, "d", n_chunks=1)
+        assert store.replication_drain(timeout_s=30.0)
+        chunks = os.path.join(cfg.store_root, "d", "chunks")
+        os.remove(os.path.join(chunks, os.listdir(chunks)[0]))
+        failpoints.configure("replicate.fetch.pre_read=raise")
+        store2 = DatasetStore(cfg)
+        ds = store2.load("d")
+        with pytest.raises(ChunkCorrupt):
+            _ = ds.columns
+        assert store2.replication_snapshot()["counters"]["errors"] >= 1
+        store2.stop_replication()
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+def test_remote_repair_invalidates_readpipe_cache(tmp_path):
+    """Satellite 1: the remote-fetch rung must drop the healed file's
+    readpipe cache entries exactly like the mirror rung — a decode
+    poisoned between rot-onset and repair must not outlive the repair."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, peers=peer.addr)
+    store = DatasetStore(cfg)
+    try:
+        _seed(store, "d", n_chunks=2)
+        assert store.replication_drain(timeout_s=30.0)
+        ds = store.get("d")
+        good = [dict(c) for c in ds.iter_chunks(["x"])]
+        chunks = os.path.join(cfg.store_root, "d", "chunks")
+        victim = sorted(os.listdir(chunks))[0]
+        vpath = os.path.join(chunks, victim)
+        crc = ds._chunks[0].crc32
+        # a stale decode cached under the journal CRC key, then rot
+        poisoned = {"x": np.full_like(good[0]["x"], -1)}
+        readpipe.cache_put(vpath, crc, ("x",), poisoned, 1024)
+        with open(vpath, "r+b") as f:
+            f.seek(12)
+            f.write(b"\x00\x00\x00\x00")
+        report = store.scrub("d")          # heals via the REMOTE rung
+        assert report["ok"]
+        assert store.replication_snapshot()["counters"]["repairs"] >= 1
+        healed = [dict(c) for c in ds.iter_chunks(["x"])]
+        for h, g in zip(healed, good):
+            np.testing.assert_array_equal(h["x"], g["x"])
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+def test_scrub_missing_chunks_dir_reports_and_repairs(tmp_path):
+    """Satellite 2: scrub over a dataset whose chunks dir is ENTIRELY
+    gone (re-imaged host) reports every chunk as missing and repairs
+    them all remotely — never a FileNotFoundError."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, peers=peer.addr)
+    store = DatasetStore(cfg)
+    try:
+        want = _seed(store, "d", n_chunks=3)
+        assert store.replication_drain(timeout_s=30.0)
+        shutil.rmtree(os.path.join(cfg.store_root, "d", "chunks"))
+        store2 = DatasetStore(cfg)
+        store2.load("d")
+        report = store2.scrub("d")
+        assert report["ok"], report
+        assert report["missing"] == 3 and report["checked"] == 3
+        assert store2.integrity_snapshot()["chunks_repaired"] == 3
+        np.testing.assert_array_equal(store2.get("d").column("x"), want)
+        store2.stop_replication()
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+def test_scrub_missing_chunks_dir_without_any_replica_reports(tmp_path):
+    """Satellite 2, unrepairable half: no mirror, no peers — scrub still
+    returns a report (ok=False, every chunk missing + an error), it does
+    not raise."""
+    cfg = _mk_cfg(tmp_path)
+    store = DatasetStore(cfg)
+    _seed(store, "d", n_chunks=2)
+    shutil.rmtree(os.path.join(cfg.store_root, "d", "chunks"))
+    store2 = DatasetStore(cfg)
+    store2.load("d")
+    report = store2.scrub("d")
+    assert not report["ok"]
+    assert report["missing"] == 2 and report["errors"]["d"]
+
+
+def test_scrub_on_load_recovers_a_reimaged_host(tmp_path):
+    """The runbook's automated leg: LO_TPU_SCRUB_ON_LOAD on a host whose
+    chunks are gone but whose journal survived heals everything from the
+    peer during load_all."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = _mk_cfg(tmp_path, peers=peer.addr)
+    store = DatasetStore(cfg)
+    try:
+        want = _seed(store, "d", n_chunks=2)
+        assert store.replication_drain(timeout_s=30.0)
+        shutil.rmtree(os.path.join(cfg.store_root, "d", "chunks"))
+        cfg2 = cfg.replace(scrub_on_load=True)
+        store2 = DatasetStore(cfg2)
+        store2.load_all()
+        assert not store2.get("d").metadata.error
+        assert store2.integrity_snapshot()["chunks_repaired"] == 2
+        np.testing.assert_array_equal(store2.get("d").column("x"), want)
+        store2.stop_replication()
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+# -- 4. the serving surface + client ------------------------------------------
+
+def test_serving_surface_and_client_passthrough(tmp_path):
+    """App wiring end-to-end: GET /replication, the /metrics
+    `replication` doc and prometheus series, the /healthz `replication`
+    check, and the client passthroughs — including the degraded-healthz
+    error naming each under-replicated dataset with its lag bytes
+    (satellite 6)."""
+    import requests
+
+    from learningorchestra_tpu.client import Context, Observability
+    from learningorchestra_tpu.serving.app import App
+
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / "store")
+    cfg.image_root = str(tmp_path / "images")
+    cfg.port = 0
+    cfg.persist = True
+    cfg.replica_peers = peer.addr
+    cfg.replica_push_retry_s = 1000.0   # outage stays visible: no retry
+    cfg.alert_window_s = 0.0
+    app = App(cfg, recover=False)
+    server = app.serve(background=True)
+    ctx = Context(f"http://127.0.0.1:{server.port}", poll_seconds=0.1,
+                  timeout=60)
+    obs = Observability(ctx)
+    try:
+        _seed(app.store, "d", n_chunks=1)
+        assert app.store.replication_drain(timeout_s=30.0)
+        doc = obs.replication()
+        assert doc["enabled"] and doc["max_lag_bytes"] == 0
+        assert doc["peers"] == [peer.addr]
+        hz = obs.healthz()
+        assert hz["checks"]["replication"]["ok"]
+        m = requests.get(ctx.url("/metrics")).json()
+        assert m["replication"]["datasets"]["d"]["lag_bytes"] == 0
+
+        peer.stop()                       # outage: the next push fails
+        _seed(app.store, "e", n_chunks=1)
+        assert app.store.replication_drain(timeout_s=30.0)
+        with pytest.raises(RuntimeError) as ei:
+            obs.healthz()
+        msg = str(ei.value)
+        assert "under-replicated e (" in msg and "B behind" in msg
+        text = requests.get(
+            ctx.url("/metrics?format=prometheus")).text
+        under = [ln for ln in text.splitlines()
+                 if ln.startswith("lo_replica_under_replicated")]
+        assert under and float(under[0].split()[-1]) == 1.0
+        assert 'lo_replica_lag_bytes{dataset="e"}' in text
+    finally:
+        server.stop()
+        peer.stop()
+
+
+# -- 5. the host-loss chaos headline (slow) -----------------------------------
+
+def _alert_engine(cfg):
+    rule = next(r for r in alerts.default_rules(cfg)
+                if r.name == "data_under_replicated")
+    return alerts.AlertEngine([rule], window_s=0.0, for_windows=1,
+                              clear_windows=1)
+
+
+@pytest.mark.slow
+def test_host_loss_chaos_end_to_end(tmp_path):
+    """THE acceptance chaos: with one peer configured, delete EVERY
+    primary chunk of a committed dataset — a full scan completes
+    bit-identically via remote repair, scrub reports all chunks
+    repaired, lo_replica_repairs moves on the prometheus exposition, and
+    the under-replication alert fires during a peer outage and resolves
+    after re-replication."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    addr, port = peer.addr, peer.port
+    cfg = _mk_cfg(tmp_path, peers=addr)
+    store = DatasetStore(cfg)
+    eng = _alert_engine(cfg)
+    try:
+        want = _seed(store, "d", n_chunks=4, rows=500)
+        assert store.replication_drain(timeout_s=60.0)
+        assert eng.evaluate(
+            {"replication": store.replication_snapshot()}) == []
+        store.stop_replication()
+
+        # -- host loss: every primary chunk of the committed dataset --
+        shutil.rmtree(os.path.join(cfg.store_root, "d", "chunks"))
+        store2 = DatasetStore(cfg)
+        ds = store2.load("d")
+        got = np.concatenate([c["x"] for c in ds.iter_chunks(["x"])])
+        np.testing.assert_array_equal(got, want)     # bit-identical scan
+        report = store2.scrub("d")
+        assert report["ok"] and report["checked"] == 4
+        snap = store2.replication_snapshot()
+        assert snap["counters"]["repairs"] == 4
+        text = prometheus.render({"replication": snap})
+        assert "lo_replica_repairs_total 4" in text
+        assert "lo_replica_fetches_total 4" in text
+
+        # -- peer outage: alert fires, restart + retry resolves it ----
+        peer.stop()
+        _seed(store2, "e", n_chunks=1)
+        assert store2.replication_drain(timeout_s=60.0)
+        snap = store2.replication_snapshot()
+        assert any(u["dataset"] == "e" for u in snap["under_replicated"])
+        (t,) = eng.evaluate({"replication": snap})
+        assert t["alert"] == "data_under_replicated"
+        assert t["to"] == "firing"
+        peer = ReplicaServer(root=str(tmp_path / "peer"), port=port)
+        snap = _drain_lag(store2)
+        assert snap["max_lag_bytes"] == 0
+        (t,) = eng.evaluate({"replication": snap})
+        assert t["to"] == "resolved"
+        store2.stop_replication()
+    finally:
+        store.stop_replication()
+        peer.stop()
+
+
+@pytest.mark.slow
+def test_peer_killed_mid_push_then_chunks_lost_after_ack(tmp_path):
+    """The other headline leg: kill the peer MID-push (chunks sent,
+    journal sync in flight) — the push fails cleanly and the dataset is
+    under-replicated; after the peer returns, the retry converges (the
+    probe skips chunks the peer already holds), and only THEN does
+    deleting the primary's chunk files heal remotely — acked bytes are
+    genuinely durable on the peer."""
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    addr, port = peer.addr, peer.port
+    cfg = _mk_cfg(tmp_path, peers=addr)
+    store = DatasetStore(cfg)
+    old_slow = failpoints.SLOW_S
+    try:
+        # hold the push inside the journal-sync seam, then yank the peer
+        failpoints.SLOW_S = 1.5
+        failpoints.configure("replicate.push.mid_stream=slow")
+        want = _seed(store, "d", n_chunks=3)
+        deadline = time.monotonic() + 30.0
+        while (failpoints.hit_counts().get(
+                "replicate.push.mid_stream", 0) < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        peer.stop()                       # dies while the push sleeps
+        assert store.replication_drain(timeout_s=60.0)
+        snap = store.replication_snapshot()
+        assert any(u["dataset"] == "d" for u in snap["under_replicated"])
+
+        failpoints.reset()
+        peer = ReplicaServer(root=str(tmp_path / "peer"), port=port)
+        snap = _drain_lag(store)
+        assert snap["max_lag_bytes"] == 0, snap
+        store.stop_replication()
+
+        # chunks acked to the peer: losing every primary copy is safe
+        shutil.rmtree(os.path.join(cfg.store_root, "d", "chunks"))
+        store2 = DatasetStore(cfg)
+        np.testing.assert_array_equal(store2.load("d").column("x"), want)
+        assert store2.integrity_snapshot()["chunks_repaired"] == 3
+        store2.stop_replication()
+    finally:
+        failpoints.SLOW_S = old_slow
+        store.stop_replication()
+        peer.stop()

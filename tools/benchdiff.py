@@ -59,6 +59,12 @@ BUILTIN_TOLERANCES: List[Tuple[str, float]] = [
     ("*replica_sweep*p50_ms", 3.0),
     ("*replica_sweep*p99_ms", 3.0),
     ("*replica_speedup", 2.0),
+    # Peer-replication bench (fault_tolerance.md §9): loopback push
+    # throughput rides disk fsync + CPU CRC timing, and the one-chunk
+    # repair smoke is a few tens of ms — both noisy on shared rigs.
+    ("*replication_bench*push_rps", 2.0),
+    ("*replication_bench*push_mb_s", 2.0),
+    ("*replication_bench*repair_duration_ms", 3.0),
 ]
 
 

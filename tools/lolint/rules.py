@@ -615,12 +615,20 @@ class FailpointCoverageRule(Rule):
     #: must be able to reach.
     _SERVING_TRIGGER_SUFFIXES = ("entry.predict", "wfile.write",
                                  "chan.queue_frame")
+    #: catalog/replicate.py trigger suffix: every socket send seam of
+    #: the replication plane (``sock.sendall`` / ``conn.sendall``) —
+    #: the exact hops the peer-loss chaos sweep must be able to crash,
+    #: tear or stall mid-push / mid-fetch / mid-reply. fsync/rename
+    #: commit seams are already covered file-wide by _COMMIT_CALLS.
+    _REPLICATE_TRIGGER_SUFFIXES = ("sendall",)
+    REPLICATE_PATH = f"{PACKAGE}/catalog/replicate.py"
 
     def applies(self, relpath: str) -> bool:
         return _in(relpath, *self.SCOPE)
 
     def check(self, pf: ParsedFile) -> Iterator[Finding]:
         serving = pf.path.startswith(f"{PACKAGE}/serving/")
+        replication = pf.path == self.REPLICATE_PATH
         declared = self.declared_sites(pf)
         seen: Set[int] = set()
         for fn in pf.functions():
@@ -644,7 +652,10 @@ class FailpointCoverageRule(Rule):
                 if cname in self._COMMIT_CALLS or (
                         serving and any(
                             cname == s or cname.endswith("." + s)
-                            for s in self._SERVING_TRIGGER_SUFFIXES)):
+                            for s in self._SERVING_TRIGGER_SUFFIXES)) or (
+                        replication and any(
+                            cname == s or cname.endswith("." + s)
+                            for s in self._REPLICATE_TRIGGER_SUFFIXES)):
                     # Attribute-boundary match: `entry.predict` /
                     # `x.entry.predict` trigger, `reentry.predict`
                     # does not.
@@ -655,13 +666,20 @@ class FailpointCoverageRule(Rule):
             if commits and not fires:
                 first = commits[0]
                 sym = pf.symbol_of(fn)
-                what = ("device-dispatch/response-write site" if serving
-                        else "commit point")
-                proof = ("the serving chaos tests (tests/"
-                         "test_serving_fault.py) cannot wedge/crash this "
-                         "seam" if serving else
-                         "the crash sweep (tests/test_failpoints.py) "
-                         "cannot prove recovery at this I/O boundary")
+                if replication:
+                    what = "replication send/commit seam"
+                    proof = ("the peer-loss chaos sweep (tests/"
+                             "test_failpoints.py replicate.* sites) "
+                             "cannot kill/tear this hop mid-push")
+                elif serving:
+                    what = "device-dispatch/response-write site"
+                    proof = ("the serving chaos tests (tests/"
+                             "test_serving_fault.py) cannot wedge/crash "
+                             "this seam")
+                else:
+                    what = "commit point"
+                    proof = ("the crash sweep (tests/test_failpoints.py) "
+                             "cannot prove recovery at this I/O boundary")
                 yield Finding(
                     self.name, pf.path, first.lineno, first.col_offset,
                     f"{call_name(first)}() {what} without a "
