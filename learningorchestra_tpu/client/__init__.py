@@ -618,6 +618,41 @@ class Model(_ServiceClient):
                                  tolerate_missing=True)
         return out
 
+    def tune(self, training_filename: str, tune_filename: str,
+             classificator: str, configs: Sequence[Dict[str, Any]],
+             label: str, steps: Sequence[Dict[str, Any]] = (),
+             folds: Optional[int] = None, rungs: Optional[int] = None,
+             promote: bool = False, sync: bool = True) -> Dict:
+        """Device-resident hyperparameter search (``POST /tune``): fit a
+        population of same-family ``configs`` as ONE vmapped device
+        program with masked k-fold cross-validation and successive
+        halving. The leaderboard (per-config fold scores, fit seconds,
+        rung survival, winner) lands in ``tune_filename``'s metadata;
+        ``promote=True`` additionally refits the winner on all rows and
+        persists it under ``tune_filename`` in the trained-model
+        registry (servable via :meth:`predict` / :meth:`predict_online`).
+        """
+        self.waiter.wait(training_filename)
+        body: Dict[str, Any] = {
+            "training_filename": training_filename,
+            "tune_filename": tune_filename,
+            "classificator": classificator,
+            "configs": list(configs),
+            "label": label, "promote": promote, "sync": sync,
+        }
+        if steps:
+            body["steps"] = list(steps)
+        if folds is not None:
+            body["folds"] = folds
+        if rungs is not None:
+            body["rungs"] = rungs
+        out = ResponseTreat.treatment(self.context.post(
+            "/tune", json=body,
+            timeout=self.context.timeout if sync else None))
+        if not sync:
+            self.waiter.wait(tune_filename, tolerate_missing=True)
+        return out
+
     # -- persisted-model registry (upgrade: reference discards models) ------
 
     def list_trained_models(self) -> List[Dict]:

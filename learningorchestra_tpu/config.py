@@ -359,6 +359,38 @@ class Settings:
     fit_ckpt_rounds: int = field(
         default_factory=lambda: _env("LO_TPU_FIT_CKPT_ROUNDS", 0)
     )
+    #: Successive-halving rungs for a hyperparameter sweep (models/
+    #: tune.py): the sweep's total unit budget (boost rounds / adam
+    #: iterations / tree batches) is cut into this many segments; after
+    #: each, every candidate's k-fold scores are taken and the bottom
+    #: half of the surviving configs is dropped (masks zeroed — the
+    #: survivors' arithmetic is untouched). ``1`` disables halving (one
+    #: rung, everyone runs to completion).
+    tune_rungs: int = field(
+        default_factory=lambda: _env("LO_TPU_TUNE_RUNGS", 3)
+    )
+    #: Cross-validation folds for tune sweeps: fold membership is an
+    #: index mask over the ONE resident design matrix (row i belongs to
+    #: fold ``i % folds``), never a data copy. ``1`` disables CV — each
+    #: candidate trains on all rows and is scored on them too.
+    tune_folds: int = field(
+        default_factory=lambda: _env("LO_TPU_TUNE_FOLDS", 3)
+    )
+    #: HBM budget (MB) for sizing a tune population wave: the largest
+    #: candidate count whose modeled per-member footprint (models/
+    #: flops.py bytes model, raised to the family's recorded
+    #: ``peak_hbm_bytes`` watermark when one exists) fits this budget
+    #: runs as ONE vmapped device program; extra candidates spill into
+    #: sequential waves (counted on ``/metrics``). ``0`` = unlimited
+    #: (one wave, trusting the device).
+    tune_hbm_budget_mb: int = field(
+        default_factory=lambda: _env("LO_TPU_TUNE_HBM_BUDGET_MB", 0)
+    )
+    #: Hard cap on candidates per vmapped wave regardless of the HBM
+    #: model — bounds compile-time shape growth for very large sweeps.
+    tune_max_population: int = field(
+        default_factory=lambda: _env("LO_TPU_TUNE_MAX_POPULATION", 64)
+    )
 
     # --- job-tier fault domain (jobs.py watchdog) ---------------------------
     #: Per-job liveness deadline (seconds): a managed job whose BODY has

@@ -85,6 +85,21 @@ class ModelBuilder:
                 raise ValueError(
                     f"prediction dataset already exists: {prediction_name}_{c}")
 
+    def validate_tune(self, train: str, out_name: str, classifier: str,
+                      configs: Sequence[Dict[str, Any]]) -> None:
+        """Synchronous admission checks for a tune sweep — everything that
+        must 4xx at the route instead of stranding an async job: missing
+        dataset (404), duplicate output (ValueError → 406), and the full
+        per-config hyperparameter validation (unknown names / out-of-range
+        values name the offending key, models/registry.HPARAM_SPECS)."""
+        from learningorchestra_tpu.models import tune as tune_mod
+
+        if not self.store.exists(train):
+            raise KeyError(f"dataset not found: {train}")
+        if self.store.exists(out_name):
+            raise ValueError(f"tune dataset already exists: {out_name}")
+        tune_mod.validate_population(classifier, configs)
+
     # -- the main path -------------------------------------------------------
 
     def build(self, train: str, test: str, prediction_name: str,
@@ -568,6 +583,106 @@ class ModelBuilder:
         preds = np.argmax(probs, axis=1)
         self._save_predictions(out_name, ds, preds, probs,
                                FitReport(kind=man["kind"], fit_time=0.0))
+
+    # -- device-resident hyperparameter search (models/tune.py) --------------
+
+    def tune(self, train: str, out_name: str, classifier: str,
+             configs: Sequence[Dict[str, Any]], label: str,
+             steps: Sequence[Dict[str, Any]] = (),
+             folds: Optional[int] = None, rungs: Optional[int] = None,
+             promote: bool = False,
+             existing: bool = False) -> Dict[str, Any]:
+        """Run one vmapped hyperparameter sweep over ``configs`` of a
+        single family against the resident design of ``train``; the
+        leaderboard (per-config fold scores, fit seconds, rung survival,
+        winner) lands in ``out_name``'s metadata and is returned.
+
+        ``promote=True`` refits the winning config on ALL rows (CV fold
+        masking off) and persists it under ``out_name`` in the trained-
+        model registry, so the sweep's product is directly servable.
+        ``existing=True`` means the async route already created the
+        marker dataset metadata-first.
+        """
+        from learningorchestra_tpu.models import tune as tune_mod
+
+        train_ds = self.store.get(train)
+        if self.cfg.stream_design or train_ds.over_budget:
+            # The member-axis fold masks multiply against ONE resident
+            # (n, d) design; a streamed design never materializes, so
+            # there is nothing to mask.
+            raise ValueError(
+                "tune sweeps need a resident design matrix; streamed "
+                "designs are fit-only")
+        steps_key = json.dumps(list(steps), sort_keys=True, default=str)
+        with tracing.span("design.build", train=train):
+            X_train, y_train, feature_fields, state = train_ds.memo(
+                ("design", label, steps_key),
+                lambda: preprocess.design_matrix(train_ds, label, steps))
+        if y_train is None:
+            raise ValueError(f"label field {label!r} not in {train!r}")
+        num_classes = max(2, int(y_train.max()) + 1)
+        pp_meta = {"steps": list(steps), "state": state,
+                   "feature_fields": feature_fields, "label": label}
+
+        if not existing:
+            self.store.create(out_name, parent=train,
+                              extra={"classifier": classifier,
+                                     "label": label, "tune": True})
+        ck_on = int(self.cfg.fit_ckpt_rounds) > 0
+        ckpt = None
+        if ck_on and not spmd.is_multiprocess():
+            # Rung-boundary checkpoints: keyed on everything that changes
+            # the sweep's arithmetic or orchestration (configs, folds,
+            # rungs, mesh shape), so a resume under ANY changed setup
+            # starts fresh instead of splicing incompatible state.
+            ckpt = fitckpt.context(
+                self.cfg, dataset=train, family=f"tune_{classifier}",
+                config={"family": classifier, "configs": list(configs),
+                        "folds": folds, "rungs": rungs, "label": label,
+                        "steps": list(steps), "num_classes": num_classes,
+                        "mesh": dict(self.runtime.mesh.shape)},
+                snapshot=f"rows={int(len(X_train))}")
+        try:
+            with device_trace(self.cfg), timed("tune"), \
+                    tracing.span("tune.sweep", family=classifier,
+                                 configs=len(configs)):
+                board = tune_mod.sweep(
+                    self.runtime, X_train, y_train, num_classes,
+                    classifier, configs, cfg=self.cfg,
+                    folds=folds, rungs=rungs, ckpt=ckpt)
+        except Exception as exc:
+            self.store.fail(out_name, f"{type(exc).__name__}: {exc}")
+            raise
+
+        if promote:
+            # Winner promotion: one full-data fit of the best config —
+            # the same trainer entry point as build, so host_prep hooks
+            # (tree quantile edges) and registry manifests match.
+            hp = dict(board["winner"]["config"])
+            trainer = get_trainer(classifier)
+            prep = getattr(trainer, "host_prep", None)
+            extra = prep(X_train, **hp) if prep is not None else {}
+            with timed("tune.promote"), resources.family_phase(classifier):
+                model = trainer(self.runtime, X_train, y_train,
+                                num_classes, **dict(hp, **extra))
+            if self.cfg.persist_models:
+                try:
+                    self.registry.save(
+                        out_name, model,
+                        metrics={"mean_score":
+                                 board["winner"]["mean_score"],
+                                 "tuned": True},
+                        preprocess=pp_meta)
+                    board["promoted"] = out_name
+                except Exception as exc:  # noqa: BLE001 — best-effort
+                    board["promote_error"] = (
+                        f"{type(exc).__name__}: {exc}")
+
+        self.store.finish(out_name, tune=board)
+        from learningorchestra_tpu import jobs
+
+        jobs.heartbeat()
+        return board
 
     def _save_predictions(self, name: str, test_ds, preds: np.ndarray,
                           probs: np.ndarray, report: FitReport) -> None:

@@ -56,38 +56,232 @@ def _loss(params, Xs, y, mask, l2):
     return data + l2 * jnp.sum(params["W"] ** 2)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "iters"))
 def _fit(X, y, n_valid, mu, sigma, *, num_classes, iters, lr, l2, seed):
-    n, d = X.shape
-    k = jax.random.PRNGKey(seed)
-    params = {
-        "W": 0.01 * jax.random.normal(k, (d, num_classes), jnp.float32),
-        "b": jnp.zeros((num_classes,), jnp.float32),
-        "mu": mu, "sigma": sigma,
-    }
-    # Standardize + bf16-cast ONCE before the scan: every Adam iteration
-    # then reads the half-size matrix instead of re-deriving it (the fit
-    # is HBM-bandwidth-bound, so this halves the per-iteration traffic).
-    Xs = ((X - mu) / sigma).astype(jnp.bfloat16)
-    mask = (jnp.arange(n) < n_valid).astype(jnp.float32)
-    opt = optax.adam(lr)
-    opt_state = opt.init(params)
+    """Adam fit = the population program at population one.
 
-    def step(carry, _):
-        params, opt_state = carry
-        loss, grads = jax.value_and_grad(_loss)(params, Xs, y, mask, l2)
-        updates, opt_state = opt.update(grads, opt_state)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state), loss
-
-    (params, _), losses = jax.lax.scan(step, (params, opt_state), None,
-                                       length=iters)
-    return params, losses
+    The standalone path and the tune sweep (models/tune.py) MUST share
+    one compiled member body: XLA's reduction orders differ between a
+    plain and a vmapped lowering of the same arithmetic (the bias
+    gradient's row-sum reorders by ~1 ulp/step), and the vmapped program
+    is batch-size invariant — so routing the single fit through the
+    vmapped body is what makes population members bit-identical to
+    standalone fits."""
+    params, opt_state = _pop_lr_init(
+        jnp.asarray([seed], jnp.int32), mu, sigma, d=X.shape[1],
+        num_classes=num_classes)
+    mask = (jnp.arange(X.shape[0]) < n_valid).astype(jnp.float32)[None]
+    params, _, losses = _fit_pop_adam(
+        params, opt_state, X, y, mask, mu, sigma,
+        jnp.asarray([lr], jnp.float32), jnp.asarray([l2], jnp.float32),
+        jnp.asarray([iters], jnp.int32), jnp.ones((1,), jnp.float32),
+        np.int32(0), iters=iters)
+    return {k: v[0] for k, v in params.items()}, losses[0]
 
 
 @jax.jit
 def _predict_proba(params, X):
     return jax.nn.softmax(_logits(params, X), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Config-population programs (models/tune.py)
+# ---------------------------------------------------------------------------
+
+def _pop_adam_tx():
+    """The population path's optimizer pair: ``scale_by_adam`` exactly as
+    ``optax.adam`` composes it, with the final ``scale(-lr)`` applied
+    manually per member so the learning rate can ride as a traced
+    per-member scalar. ``(-x)·lr ≡ x·(-lr)`` in IEEE floats, so updates
+    are bit-identical to ``optax.adam(lr)``'s."""
+    return optax.scale_by_adam()
+
+
+def _pop_lr_init(seeds, mu, sigma, *, d, num_classes):
+    """Stacked per-member init — each member's W is the PRNGKey(seed)
+    draw its standalone fit would make (key packing and the normal draw
+    are deterministic functions of the seed)."""
+
+    @partial(jax.jit, static_argnames=("d", "num_classes"))
+    def init(seeds, mu, sigma, *, d, num_classes):
+        def one(seed):
+            k = jax.random.PRNGKey(seed)
+            return {
+                "W": 0.01 * jax.random.normal(k, (d, num_classes),
+                                              jnp.float32),
+                "b": jnp.zeros((num_classes,), jnp.float32),
+                "mu": mu, "sigma": sigma,
+            }
+
+        params = jax.vmap(one)(seeds)
+        opt_state = jax.vmap(_pop_adam_tx().init)(params)
+        return params, opt_state
+
+    return init(seeds, mu, sigma, d=d, num_classes=num_classes)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _fit_pop_adam(params, opt_state, X, y, masks, mu, sigma, lrs, l2s,
+                  iters_vec, alive, t0, *, iters):
+    """One SEGMENT of Adam steps for a POPULATION of lr configs.
+
+    Per member: its own loss mask (validity × fold-train), lr, l2 and
+    iteration budget. Global step ``t0 + i`` past a member's
+    ``iters_vec`` (or a dead ``alive`` flag) freezes its params and
+    optimizer state via ``where`` — the frozen values are exactly the
+    standalone fit's final state, so segmenting and halving never
+    perturb a surviving member's arithmetic."""
+    Xs = ((X - mu) / sigma).astype(jnp.bfloat16)
+
+    def one_member(params, opt_state, mask, lr, l2, it_m, alive_m):
+        tx = _pop_adam_tx()
+
+        def step(carry, i):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(_loss)(params, Xs, y, mask,
+                                                    l2)
+            updates, new_state = tx.update(grads, opt_state)
+            new_params = optax.apply_updates(
+                params, jax.tree.map(lambda u: u * (-lr), updates))
+            act = ((t0 + i) < it_m) & (alive_m > 0)
+            params = jax.tree.map(
+                lambda a, b: jnp.where(act, a, b), new_params, params)
+            opt_state = jax.tree.map(
+                lambda a, b: jnp.where(act, a, b), new_state, opt_state)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(iters))
+        return params, opt_state, losses
+
+    return jax.vmap(one_member)(params, opt_state, masks, lrs, l2s,
+                                iters_vec, alive)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "iters", "mesh"))
+def _fit_pop_newton(X, y, masks, mu, sigma, l2s, iters_vec, alive, Wz0,
+                    t0, *, num_classes, iters, mesh):
+    """One SEGMENT of Newton/IRLS steps for a POPULATION of lr configs —
+    the serial ``_fit_newton`` body vmapped over members with per-member
+    l2 (traced into the ridge), loss masks and step budgets. The shared
+    standardized [X | 1] block matrix is built once."""
+    C = num_classes
+    d = X.shape[1]
+    d1 = d + 1
+
+    def shard_fn(X, y, masks, mu, sigma, l2s, iters_vec, alive, Wz0, t0):
+        nloc = X.shape[0]
+        Z = jnp.concatenate(
+            [((X - mu) / sigma), jnp.ones((nloc, 1), jnp.float32)],
+            axis=1).astype(jnp.bfloat16)
+        blk = min(_NEWTON_BLOCK, nloc)
+        nbk = -(-nloc // blk)
+        pad = nbk * blk - nloc
+        if pad:
+            Z = jnp.pad(Z, ((0, pad), (0, 0)))
+            y_p = jnp.pad(y, (0, pad))
+        else:
+            y_p = y
+
+        def one_member(mask, l2, it_m, alive_m, Wz):
+            ridge = jnp.tile(jnp.concatenate(
+                [jnp.full((d,), 2.0 * l2), jnp.zeros((1,))]), C) + 1e-4
+            nf = jnp.maximum(
+                jax.lax.psum(mask.sum(), DATA_AXIS), 1.0)
+            mask_p = jnp.pad(mask, (0, pad)) if pad else mask
+
+            def step(Wz, i):
+                def acc_block(carry, b):
+                    g, T1, T2 = carry
+                    Zblk = jax.lax.dynamic_slice_in_dim(Z, b * blk, blk)
+                    yblk = jax.lax.dynamic_slice_in_dim(y_p, b * blk,
+                                                        blk)
+                    mblk = jax.lax.dynamic_slice_in_dim(mask_p, b * blk,
+                                                        blk)
+                    logits = (Zblk @ Wz.astype(jnp.bfloat16)).astype(
+                        jnp.float32)
+                    Pr = jax.nn.softmax(logits, axis=-1) * mblk[:, None]
+                    Y1 = (jax.nn.one_hot(yblk, C, dtype=jnp.float32)
+                          * mblk[:, None])
+                    R = (Pr - Y1).astype(jnp.bfloat16)
+                    g = g + (Zblk.T @ R).astype(jnp.float32)
+                    Pb = Pr.astype(jnp.bfloat16)
+                    A = (Pb[:, :, None] * Zblk[:, None, :]).reshape(
+                        blk, C * d1)
+                    T2 = T2 + (A.T @ A).astype(jnp.float32)
+                    T1 = T1 + jnp.stack([
+                        (Zblk.T @ (Zblk * Pb[:, c:c + 1])).astype(
+                            jnp.float32)
+                        for c in range(C)])
+                    return (g, T1, T2), None
+
+                (g, T1, T2), _ = jax.lax.scan(
+                    acc_block,
+                    (jnp.zeros((d1, C), jnp.float32),
+                     jnp.zeros((C, d1, d1), jnp.float32),
+                     jnp.zeros((C * d1, C * d1), jnp.float32)),
+                    jnp.arange(nbk))
+                g, T1, T2 = jax.lax.psum((g, T1, T2), DATA_AXIS)
+                gflat = (g.T.reshape(C * d1) / nf
+                         + ridge * Wz.T.reshape(C * d1))
+                H = jax.scipy.linalg.block_diag(
+                    *[T1[c] for c in range(C)]) - T2
+                H = H / nf + jnp.diag(ridge)
+                delta = jnp.linalg.solve(H, gflat)
+                norm = jnp.linalg.norm(delta)
+                delta = delta * jnp.minimum(
+                    1.0, 5.0 / jnp.maximum(norm, 1e-12))
+                delta = jnp.where(jnp.isfinite(delta), delta, 0.0)
+                act = ((t0 + i) < it_m) & (alive_m > 0)
+                return jnp.where(act, Wz - delta.reshape(C, d1).T, Wz), \
+                    None
+
+            Wz, _ = jax.lax.scan(step, Wz, jnp.arange(iters))
+            return Wz
+
+        # lax.map, NOT vmap: the Hessian accumulation is bf16 matmuls,
+        # and XLA tiles a BATCHED bf16 contraction differently at every
+        # batch width — vmapped members drift ~1e-3 from their standalone
+        # fits and even from themselves at other population sizes. A
+        # scan over members runs the one unbatched member program per
+        # config, which is what makes population newton bit-identical to
+        # serial newton. Members are large-matmul-bound, so serializing
+        # them costs little against the shared-compile/shared-data win.
+        return jax.lax.map(
+            lambda args: one_member(*args),
+            (masks, l2s, iters_vec, alive, Wz0))
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(None, DATA_AXIS), P(),
+                  P(), P(), P(), P(), P(), P()),
+        out_specs=P(), check_vma=False,
+    )(X, y, masks, mu, sigma, l2s, iters_vec, alive, Wz0, t0)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _pop_lr_scores(W, b, mu, sigma, X, y, ew_pop, *, mesh):
+    """Per-member lr accuracy on per-member (eval-fold) row weights."""
+
+    def shard_fn(W, b, mu, sigma, X, y, ew_pop):
+        Xs = ((X - mu) / sigma).astype(jnp.bfloat16)
+
+        def one_member(W_m, b_m, ew):
+            logits = (Xs @ W_m.astype(jnp.bfloat16)).astype(
+                jnp.float32) + b_m
+            pred = jnp.argmax(logits, axis=1).astype(y.dtype)
+            hit = jax.lax.psum(
+                ((pred == y).astype(jnp.float32) * ew).sum(), DATA_AXIS)
+            tot = jax.lax.psum(ew.sum(), DATA_AXIS)
+            return hit / jnp.maximum(tot, 1.0)
+
+        return jax.vmap(one_member)(W, b, ew_pop)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                  P(None, DATA_AXIS)),
+        out_specs=P(), check_vma=False,
+    )(W, b, mu, sigma, X, y, ew_pop)
 
 
 #: Rows per Newton accumulation block (bounds the lane-padded transient

@@ -212,3 +212,93 @@ def fit(runtime: MeshRuntime, X: np.ndarray, y: np.ndarray,
 @jax.jit
 def _predict_proba(params, X):
     return jax.nn.softmax(forward(params, X), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Config-population programs (models/tune.py)
+# ---------------------------------------------------------------------------
+
+def _pop_mlp_init(seeds, hiddens, d, num_classes, mu, sigma, *,
+                  model_mult):
+    """Width-padded stacked init. Each member's W1/W2 are drawn at the
+    member's OWN rounded hidden width — the normal draw depends on the
+    array shape, so initializing at the padded width would diverge from
+    the standalone fit — then zero-padded to the population max. The
+    padded W1 columns / b1 entries / W2 rows receive exactly-zero
+    gradients forever (relu'(0) = 0, and adam's 0/(√0+eps) update is 0),
+    so they stay zero and each padded forward pass only adds exact-0.0
+    terms to the hidden contraction."""
+    m = int(model_mult)
+    rounded = [((int(h) + m - 1) // m) * m for h in hiddens]
+    h_max = max(rounded)
+    stacks = {k: [] for k in ("W1", "b1", "W2", "b2", "mu", "sigma")}
+    for seed, h in zip(seeds, rounded):
+        p = init_params(jax.random.PRNGKey(int(seed)), d, h, num_classes)
+        stacks["W1"].append(np.pad(np.asarray(p["W1"]),
+                                   ((0, 0), (0, h_max - h))))
+        stacks["b1"].append(np.pad(np.asarray(p["b1"]), (0, h_max - h)))
+        stacks["W2"].append(np.pad(np.asarray(p["W2"]),
+                                   ((0, h_max - h), (0, 0))))
+        stacks["b2"].append(np.asarray(p["b2"]))
+        stacks["mu"].append(np.asarray(mu, np.float32))
+        stacks["sigma"].append(np.asarray(sigma, np.float32))
+    params = {k: jnp.asarray(np.stack(v)) for k, v in stacks.items()}
+    opt_state = jax.vmap(optax.scale_by_adam().init)(params)
+    return params, opt_state, rounded
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _run_pop(params, opt_state, X, y, masks, lrs, l2s, iters_vec, alive,
+             t0, *, iters):
+    """One SEGMENT of Adam steps for a POPULATION of mlp configs — the
+    serial ``run`` scan vmapped over members with per-member loss mask,
+    traced learning rate (``scale_by_adam`` + manual ``u * (-lr)``, the
+    exact multiply ``optax.adam``'s final ``scale(-lr)`` performs), l2
+    and iteration budget. Steps past a member's budget (or a zeroed
+    ``alive`` flag after a halving drop) freeze its params AND optimizer
+    state via ``where``, so the member's final arithmetic is identical
+    to its standalone fit."""
+
+    def one_member(params, opt_state, mask, lr, l2, it_m, alive_m):
+        tx = optax.scale_by_adam()
+
+        def body(carry, i):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, X, y, mask,
+                                                      l2)
+            updates, new_state = tx.update(grads, opt_state)
+            new_params = optax.apply_updates(
+                params, jax.tree.map(lambda u: u * (-lr), updates))
+            act = ((t0 + i) < it_m) & (alive_m > 0)
+            params = jax.tree.map(
+                lambda a, b: jnp.where(act, a, b), new_params, params)
+            opt_state = jax.tree.map(
+                lambda a, b: jnp.where(act, a, b), new_state, opt_state)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), jnp.arange(iters))
+        return params, opt_state, losses
+
+    # lax.map, NOT vmap: both hidden-layer contractions are bf16
+    # matmuls over a per-member W1/W2, and XLA tiles a batched bf16
+    # matmul differently at every batch width — vmapped members drift
+    # by ulps from their standalone fits and from themselves at other
+    # population sizes. A scan over members runs the one unbatched
+    # member program per config, which is what makes population mlp
+    # bit-identical to serial mlp (tests/test_tune.py pins it).
+    return jax.lax.map(
+        lambda args: one_member(*args),
+        (params, opt_state, masks, lrs, l2s, iters_vec, alive))
+
+
+@jax.jit
+def _pop_mlp_scores(params, X, y, ew_pop):
+    """Per-member accuracy on per-member (eval-fold) row weights."""
+
+    def one_member(params, ew):
+        pred = jnp.argmax(forward(params, X), axis=1).astype(y.dtype)
+        hit = ((pred == y).astype(jnp.float32) * ew).sum()
+        return hit / jnp.maximum(ew.sum(), 1.0)
+
+    return jax.vmap(one_member)(params, ew_pop)

@@ -9,7 +9,7 @@ names here, plus the TPU-native extensions: "mlp" (dp×tp perceptron) and
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Any, Callable, Dict, Tuple
 
 from learningorchestra_tpu.models import (
     logistic, mlp, naive_bayes, sequence, trees)
@@ -30,6 +30,88 @@ CLASSIFIERS: Dict[str, Callable] = {
 #: out-of-domain for it (its serving story is the batch predictions
 #: route).
 ONLINE_KINDS = ("lr", "nb", "dt", "rf", "gb", "mlp")
+
+
+def _int_range(lo: int, hi: int) -> Tuple[Callable, str]:
+    return (lambda v: isinstance(v, int) and not isinstance(v, bool)
+            and lo <= v <= hi, f"an integer in [{lo}, {hi}]")
+
+
+def _positive() -> Tuple[Callable, str]:
+    return (lambda v: isinstance(v, (int, float))
+            and not isinstance(v, bool) and v > 0, "a number > 0")
+
+
+def _nonneg() -> Tuple[Callable, str]:
+    return (lambda v: isinstance(v, (int, float))
+            and not isinstance(v, bool) and v >= 0, "a number >= 0")
+
+
+def _choice(*opts: str) -> Tuple[Callable, str]:
+    return (lambda v: v in opts, f"one of {sorted(opts)}")
+
+
+def _boolean() -> Tuple[Callable, str]:
+    return (lambda v: isinstance(v, bool), "a boolean")
+
+
+#: Per-family user-settable hyperparameters with their legal ranges —
+#: the single validation table behind the 406s on ``POST /models`` and
+#: ``POST /tune``. Keys the builder injects itself (``edges``, ``ckpt``)
+#: are deliberately absent: a request naming them is rejected as
+#: unknown instead of silently colliding with the injected values. The
+#: tree-depth/bin caps mirror the builders' structural limits (uint8
+#: bin codes; 2^(depth+1)-1 node arrays).
+_SEED = _int_range(0, 2 ** 31 - 1)
+HPARAM_SPECS: Dict[str, Dict[str, Tuple[Callable, str]]] = {
+    "lr": {"seed": _SEED, "iters": _int_range(1, 1_000_000),
+           "lr": _positive(), "l2": _nonneg(),
+           "solver": _choice("auto", "newton", "adam")},
+    "dt": {"seed": _SEED, "max_depth": _int_range(1, 12),
+           "n_bins": _int_range(2, 256)},
+    "rf": {"seed": _SEED, "max_depth": _int_range(1, 12),
+           "n_bins": _int_range(2, 256), "n_trees": _int_range(1, 1024),
+           "mtry": _int_range(1, 65536)},
+    "gb": {"seed": _SEED, "max_depth": _int_range(1, 12),
+           "n_bins": _int_range(2, 256), "n_rounds": _int_range(1, 4096),
+           "step_size": _positive()},
+    "nb": {"seed": _SEED, "smoothing": _positive(),
+           "event_model": _choice("gaussian", "multinomial")},
+    "mlp": {"seed": _SEED, "hidden": _int_range(1, 65536),
+            "iters": _int_range(1, 1_000_000), "lr": _positive(),
+            "l2": _nonneg()},
+    "tx": {"seed": _SEED, "d_model": _int_range(8, 4096),
+           "n_heads": _int_range(1, 64), "n_layers": _int_range(1, 64),
+           "d_ff": _int_range(8, 16384), "vocab": _int_range(0, 2 ** 22),
+           "train_steps": _int_range(1, 1_000_000),
+           "batch": _int_range(1, 1 << 22), "lr": _positive(),
+           "causal": _boolean(), "remat": _boolean()},
+}
+
+
+def validate_hparams(classifier: str, hparams: Any) -> None:
+    """Reject unknown hyperparameter names and out-of-range values with a
+    ValueError NAMING the offending key (the serving tier maps it to a
+    406) — instead of the TypeError-500 a bad ``**kwargs`` splat would
+    raise from deep inside a trainer."""
+    get_trainer(classifier)  # unknown classifier: its own ValueError
+    if hparams in (None, {}):
+        return
+    if not isinstance(hparams, dict):
+        raise ValueError(
+            f"hparams for classifier {classifier!r} must be an object of "
+            f"name->value, got {type(hparams).__name__}")
+    spec = HPARAM_SPECS[classifier]
+    for key, value in hparams.items():
+        if key not in spec:
+            raise ValueError(
+                f"unknown hparam {key!r} for classifier {classifier!r}; "
+                f"known: {sorted(spec)}")
+        check, expect = spec[key]
+        if not check(value):
+            raise ValueError(
+                f"hparam {key!r} for classifier {classifier!r} is out of "
+                f"range: expected {expect}, got {value!r}")
 
 
 def get_trainer(name: str) -> Callable:

@@ -302,7 +302,7 @@ def _leaf_stats_xla(assign, stats_T, *, n_nodes, blk):
 
 def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
                 gain_fn, weight_fn, min_child_weight, min_gain,
-                use_kernel=False):
+                use_kernel=False, bin_gain_mask=None, level_allow=None):
     """Grow one tree. All shapes static; call inside shard_map.
 
     B: (n, d) uint8 bin codes (local shard rows).
@@ -318,6 +318,17 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         blocked XLA contraction oracle. Must be static (it selects the
         compiled program); split decisions and per-level psums are
         identical either way.
+    bin_gain_mask: optional (n_bins,) float32 traced mask — 0 allows a
+        split threshold, NEG forbids it. The hyperparameter-population
+        path (models/tune.py) builds at the population's STATIC maximum
+        n_bins and forbids thresholds ≥ a member's own n_bins - 1, which
+        reproduces that member's standalone split set exactly (its high
+        bins hold zero mass, so allowed gains are bit-identical).
+    level_allow: optional (max_depth,) traced mask — False forbids
+        splitting any node at that level. Same population trick for
+        per-member max_depth under a static maximum: forbidden levels
+        leave nodes as leaves, so node ids [0, 2^(member_depth+1)-1)
+        match a standalone build at the member's own depth.
 
     Returns (feat (M,), thr (M,), is_internal (M,), leaf_stats (M, S)) with
     M = 2^(max_depth+1) - 1 nodes; children of i at 2i+1 / 2i+2.
@@ -349,7 +360,8 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
     #: and every id ≥ 2^(l+1)-1 is level-(l+1)+ territory).
     NL = 2 ** max(max_depth - 1, 0)
 
-    def level_step(carry, l):
+    def level_step(carry, xs):
+        l, lvl_ok = xs
         feat, thr, is_internal, assign = carry
         offset = jnp.left_shift(1, l) - 1            # 2^l - 1
         nl = offset + 1                              # 2^l real nodes
@@ -375,13 +387,15 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
         rw = weight_fn(total) - lw
         ok = (lw >= min_child_weight) & (rw >= min_child_weight)
         gain = jnp.where(ok, gain, NEG) + feat_gain_mask[None, :, None]
+        if bin_gain_mask is not None:
+            gain = gain + bin_gain_mask[None, None, :]
 
         flat = gain.reshape(NL, d * n_bins)
         best = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
         best_f = (best // n_bins).astype(jnp.int32)
         best_t = (best % n_bins).astype(jnp.int32)
-        split = best_gain > min_gain
+        split = (best_gain > min_gain) & lvl_ok
 
         node_ids = offset + jnp.arange(NL)
         feat = feat.at[node_ids].set(jnp.where(split, best_f, 0))
@@ -396,11 +410,13 @@ def _build_tree(B, stats_T, feat_gain_mask, *, max_depth, n_bins,
                                    best_t, split, blk=blk)
         return (feat, thr, is_internal, asg), None
 
+    if level_allow is None:
+        level_allow = jnp.ones((max_depth,), bool)
     (feat, thr, is_internal, assign), _ = jax.lax.scan(
         level_step,
         (jnp.zeros((M,), jnp.int32), jnp.zeros((M,), jnp.int32),
          jnp.zeros((M,), bool), jnp.zeros((n_pad,), jnp.int32)),
-        jnp.arange(max_depth))
+        (jnp.arange(max_depth), level_allow))
 
     # Leaf sufficient statistics over ALL nodes (every row sits at a leaf;
     # padded columns carry zero stats).
@@ -595,6 +611,244 @@ def _fit_forest_batch(B, y, valid, keys_b, *, num_classes, max_depth,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=P(), check_vma=False,
     )(B, y, valid, keys_b)
+
+
+# ---------------------------------------------------------------------------
+# Config-population programs (models/tune.py)
+#
+# The hyperparameter-search tier vmaps a POPULATION of same-family
+# configs over the member axis — the tree-batch vmap one level up. All
+# static shapes are the population's maxima (max_depth, n_bins,
+# n_trees); a member's smaller depth/bin-count is enforced by the
+# traced ``level_allow``/``bin_gain_mask`` arguments of ``_build_tree``,
+# which reproduce the member's standalone split set exactly. Per-member
+# row weights carry validity × k-fold membership (and drop to zero when
+# successive halving kills the member), so folds are index masks over
+# the ONE resident design — never data copies. The Pallas kernel path
+# stays off here: the kernels are shaped per single tree, and the
+# oracle contraction is the documented bit-parity reference.
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _bin_features_pop(X, edges_pop):
+    """Per-member bin codes from per-member (inf-padded) edge stacks:
+    (n, d) × (Pm, d, n_bins_max - 1) → (Pm, n, d) uint8. Padding edges
+    with +inf yields codes bit-identical to binning with the member's
+    own (shorter) edge list."""
+    return jax.vmap(lambda e: bin_features(X, e))(edges_pop)
+
+
+@partial(jax.jit,
+         static_argnames=("num_classes", "max_depth", "n_bins", "n_trees",
+                          "mesh"))
+def _fit_forest_pop_batch(B_pop, y, w_pop, bin_mask, level_allow,
+                          mtry_vec, keys_b, *, num_classes, max_depth,
+                          n_bins, n_trees, mesh):
+    """One vmapped tree batch for a POPULATION of dt/rf configs.
+
+    Mirrors ``_fit_forest_batch`` with a member axis on top: per member
+    its own bin matrix, row weights (validity × fold × alive), bin/level
+    masks and mtry. ``n_trees`` is the population-shared forest size (it
+    selects the bagging branch and the key count, exactly as in the
+    serial oracle, so per-member trees are bit-identical to that
+    member's standalone fit)."""
+
+    def shard_fn(B_pop, y, w_pop, bin_mask, level_allow, mtry_vec,
+                 keys_b):
+        d = B_pop.shape[2]
+        classes = jnp.arange(num_classes, dtype=y.dtype)[:, None]
+
+        def one_member(B, w, bmask, lallow, mtry_m, keys):
+            base_stats = ((y[None, :] == classes).astype(jnp.float32)
+                          * w[None, :])
+
+            def one_tree(key):
+                kb, kf = jax.random.split(key)
+                if n_trees == 1:
+                    stats = base_stats
+                    fmask = jnp.zeros((d,), jnp.float32)
+                else:
+                    kb = jax.random.fold_in(
+                        kb, jax.lax.axis_index(DATA_AXIS))
+                    wb = jax.random.poisson(
+                        kb, 1.0, (B.shape[0],)).astype(jnp.float32)
+                    stats = base_stats * wb[None, :]
+                    # First-mtry-of-perm mask via the inverse permutation
+                    # (rank < mtry) — the traced-mtry form of the
+                    # oracle's static ``perm[:mtry]`` scatter; the
+                    # resulting feature set is identical.
+                    perm = jax.random.permutation(kf, d)
+                    allowed = jnp.argsort(perm) < mtry_m
+                    fmask = jnp.where(allowed, 0.0, NEG)
+                return _build_tree(
+                    B, stats, fmask, max_depth=max_depth, n_bins=n_bins,
+                    gain_fn=_gini_gain, weight_fn=lambda s: s.sum(-1),
+                    min_child_weight=1.0, min_gain=1e-9,
+                    use_kernel=False, bin_gain_mask=bmask,
+                    level_allow=lallow)
+
+            return jax.vmap(one_tree)(keys)
+
+        return jax.vmap(one_member)(B_pop, w_pop, bin_mask, level_allow,
+                                    mtry_vec, keys_b)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(None, DATA_AXIS),
+                  P(), P(), P(), P()),
+        out_specs=P(), check_vma=False,
+    )(B_pop, y, w_pop, bin_mask, level_allow, mtry_vec, keys_b)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "mesh"))
+def _forest_pop_scores(B_pop, y, ew_pop, feat, thr, internal, leaf, *,
+                       max_depth, mesh):
+    """Per-member forest accuracy on per-member (eval-fold) row weights.
+
+    Tree arrays arrive at the FULL (Pm, n_trees, ...) shape with
+    all-zero slots for not-yet-built trees (their leaf counts are zero,
+    contributing zero probability mass), so every halving rung scores
+    through this one compiled program."""
+
+    def shard_fn(B_pop, y, ew_pop, feat, thr, internal, leaf):
+        def one_member(B, ew, f, t, it, lf):
+            def tree_proba(f1, t1, it1, lf1):
+                assign = _descend(B, f1, t1, it1, max_depth)
+                counts = _sel_rows_blocked(lf1, assign)
+                return counts / jnp.maximum(
+                    counts.sum(-1, keepdims=True), 1e-12)
+
+            probs = jax.vmap(tree_proba)(f, t, it, lf).mean(axis=0)
+            pred = jnp.argmax(probs, axis=1).astype(y.dtype)
+            hit = jax.lax.psum(
+                ((pred == y).astype(jnp.float32) * ew).sum(), DATA_AXIS)
+            tot = jax.lax.psum(ew.sum(), DATA_AXIS)
+            return hit / jnp.maximum(tot, 1.0)
+
+        return jax.vmap(one_member)(B_pop, ew_pop, feat, thr, internal,
+                                    leaf)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(None, DATA_AXIS),
+                  P(), P(), P(), P()),
+        out_specs=P(), check_vma=False,
+    )(B_pop, y, ew_pop, feat, thr, internal, leaf)
+
+
+@partial(jax.jit,
+         static_argnames=("max_depth", "n_bins", "n_rounds", "mesh"))
+def _fit_gbt_pop_seg(B_pop, y, w_pop, margin0, step_sizes, round_active,
+                     bin_mask, level_allow, *, max_depth, n_bins,
+                     n_rounds, mesh):
+    """One SEGMENT of boost rounds for a POPULATION of gb configs.
+
+    ``round_active`` is (Pm, n_rounds) ∈ {0, 1}: a zero round leaves the
+    member's margin untouched and zeroes the round's leaf values (so the
+    stacked trees stay inert in prediction) — this is how per-member
+    ``n_rounds`` under the static maximum and halving-dropped members
+    are expressed. Per-member ``step_sizes`` ride as traced scalars, the
+    boost-round arithmetic is the serial oracle's (lam = 1.0)."""
+
+    def shard_fn(B_pop, y, w_pop, margin0, step_sizes, round_active,
+                 bin_mask, level_allow):
+        gain_fn = _make_newton_gain(1.0)
+        yf = y.astype(jnp.float32)
+
+        def one_member(B, w, margin, step_size, ractive, bmask, lallow):
+            def boost_round(margin, act):
+                p = jax.nn.sigmoid(margin)
+                g = (p - yf) * w
+                h = jnp.maximum(p * (1 - p), 1e-6) * w
+                stats = jnp.stack([g, h], axis=0)
+                feat, thr, internal, leaf = _build_tree(
+                    B, stats, jnp.zeros((B.shape[1],), jnp.float32),
+                    max_depth=max_depth, n_bins=n_bins, gain_fn=gain_fn,
+                    weight_fn=lambda s: s[..., 1],
+                    min_child_weight=1e-3, min_gain=1e-9,
+                    use_kernel=False, bin_gain_mask=bmask,
+                    level_allow=lallow)
+                leaf_val = (-leaf[:, 0] / (leaf[:, 1] + 1.0)) * act
+                assign = _descend(B, feat, thr, internal, max_depth)
+                margin = margin + step_size * _sel_table_blocked(
+                    leaf_val, assign)
+                return margin, (feat, thr, internal, leaf_val)
+
+            margin, trees_out = jax.lax.scan(boost_round, margin,
+                                             ractive)
+            return trees_out, margin
+
+        return jax.vmap(one_member)(B_pop, w_pop, margin0, step_sizes,
+                                    round_active, bin_mask, level_allow)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS), P(), P(), P(), P()),
+        out_specs=(P(), P(None, DATA_AXIS)), check_vma=False,
+    )(B_pop, y, w_pop, margin0, step_sizes, round_active, bin_mask,
+      level_allow)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "mesh"))
+def _gbt_pop_replay_margin(B_pop, feat, thr, internal, leaf_val,
+                           step_sizes, *, max_depth, mesh):
+    """Per-member margin replay from checkpointed population trees — the
+    resume path's analogue of ``_gbt_replay_margin``. Leaf values were
+    stored already round-activity-scaled, so the replayed fold is the
+    training scan's own sequence bit-for-bit."""
+
+    def shard_fn(B_pop, feat, thr, internal, leaf_val, step_sizes):
+        def one_member(B, f, t, it, lv, ss):
+            def one(margin, tree):
+                f1, t1, it1, lv1 = tree
+                assign = _descend(B, f1, t1, it1, max_depth)
+                return margin + ss * _sel_table_blocked(lv1, assign), None
+
+            margin, _ = jax.lax.scan(
+                one, jnp.zeros(B.shape[0], jnp.float32), (f, t, it, lv))
+            return margin
+
+        return jax.vmap(one_member)(B_pop, feat, thr, internal, leaf_val,
+                                    step_sizes)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), P(), P(), P(), P(), P()),
+        out_specs=P(None, DATA_AXIS), check_vma=False,
+    )(B_pop, feat, thr, internal, leaf_val, step_sizes)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "mesh"))
+def _gbt_pop_scores(B_pop, y, ew_pop, feat, thr, internal, leaf_val,
+                    step_sizes, *, max_depth, mesh):
+    """Per-member binary-gb accuracy on eval-fold weights. Unbuilt/inert
+    rounds carry zero leaf values, so the fixed (Pm, R_max, ...) shape
+    scores every rung through one compiled program."""
+
+    def shard_fn(B_pop, y, ew_pop, feat, thr, internal, leaf_val,
+                 step_sizes):
+        def one_member(B, ew, f, t, it, lv, ss):
+            def tree_margin(f1, t1, it1, lv1):
+                return _sel_table_blocked(
+                    lv1, _descend(B, f1, t1, it1, max_depth))
+
+            margin = ss * jax.vmap(tree_margin)(f, t, it, lv).sum(axis=0)
+            pred = (jax.nn.sigmoid(margin) > 0.5).astype(y.dtype)
+            hit = jax.lax.psum(
+                ((pred == y).astype(jnp.float32) * ew).sum(), DATA_AXIS)
+            tot = jax.lax.psum(ew.sum(), DATA_AXIS)
+            return hit / jnp.maximum(tot, 1.0)
+
+        return jax.vmap(one_member)(B_pop, ew_pop, feat, thr, internal,
+                                    leaf_val, step_sizes)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(None, DATA_AXIS),
+                  P(), P(), P(), P(), P()),
+        out_specs=P(), check_vma=False,
+    )(B_pop, y, ew_pop, feat, thr, internal, leaf_val, step_sizes)
 
 
 def _edge_prep(X, n_bins: int = 32, **_ignored) -> dict:

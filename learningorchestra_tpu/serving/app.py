@@ -32,6 +32,7 @@ from learningorchestra_tpu import config
 from learningorchestra_tpu.config import Settings, settings as global_settings
 from learningorchestra_tpu.jobs import JobManager, select_retry_groups
 from learningorchestra_tpu.models.builder import ModelBuilder
+from learningorchestra_tpu.models.registry import validate_hparams
 from learningorchestra_tpu.ops.dtypes import convert_fields
 from learningorchestra_tpu.ops.histogram import create_histogram
 from learningorchestra_tpu.ops.projection import create_projection
@@ -406,6 +407,12 @@ class App:
             hparams = req.body.get("hparams")
             sync = bool(req.body.get("sync", True))
             app.builder.validate(train, test, classifiers, pred_name)
+            # Hyperparameter admission: unknown names / out-of-range
+            # values 406 HERE, naming the offending key — never a
+            # TypeError-500 from a **kwargs splat deep inside a trainer
+            # (or worse, a stranded async prediction dataset).
+            for c in classifiers:
+                validate_hparams(c, (hparams or {}).get(c))
 
             if sync:
                 # The reference's POST /models blocks until all fits finish
@@ -446,6 +453,57 @@ class App:
             app.jobs.submit("model_builder", pred_datasets, run)
             return 201, {"result": "model build started",
                          "prediction_datasets": pred_datasets}
+
+        # ---- device-resident hyperparameter search (models/tune.py):
+        # one family, a population of configs vmapped into one device
+        # program, masked k-fold CV over the resident design, successive
+        # halving on checkpoint rungs. The leaderboard lands in the
+        # marker dataset's metadata; promote=true additionally refits
+        # the winner on all rows and persists it under tune_filename in
+        # the trained-model registry.
+        @self._route("POST", "/tune")
+        def tune_sweep(req):
+            spmd.require_pod_health()
+            (train, out, classifier, configs, label) = req.require(
+                "training_filename", "tune_filename", "classificator",
+                "configs", "label")
+            steps = req.body.get("steps", ())
+            folds = req.body.get("folds")
+            rungs = req.body.get("rungs")
+            promote = bool(req.body.get("promote", False))
+            sync = bool(req.body.get("sync", True))
+            # Admission BEFORE any dataset exists: a bad config 406s
+            # naming the offending key (models/registry.HPARAM_SPECS)
+            # instead of stranding a doomed async marker.
+            app.builder.validate_tune(train, out, classifier, configs)
+
+            if sync:
+                board = app.builder.tune(train, out, classifier, configs,
+                                         label, steps=steps, folds=folds,
+                                         rungs=rungs, promote=promote)
+                return 201, {"result": board}
+
+            # Metadata-first marker + recorded job spec: a pod death
+            # mid-sweep re-runs the sweep from this record, and the
+            # rung-boundary fit checkpoints make the re-run resume
+            # instead of restarting (builder.tune → tune.sweep).
+            job_spec = {"kind": "tune", "train": train, "out": out,
+                        "classifier": classifier,
+                        "configs": list(configs), "label": label,
+                        "steps": list(steps), "folds": folds,
+                        "rungs": rungs, "promote": promote}
+            app.store.create(out, parent=train,
+                             extra={"classifier": classifier,
+                                    "label": label, "tune": True,
+                                    "job": job_spec})
+
+            def run():
+                app.builder.tune(train, out, classifier, configs, label,
+                                 steps=steps, folds=folds, rungs=rungs,
+                                 promote=promote, existing=True)
+
+            app.jobs.submit("tune", out, run)
+            return 201, {"result": "tune sweep started", "poll": out}
 
         # ---- trained-model registry (upgrade: the reference discards
         # fitted models, SURVEY.md §5; here they persist + re-serve)
@@ -745,6 +803,7 @@ class App:
         number the operator cannot see."""
         from learningorchestra_tpu import jobs as jobs_module
         from learningorchestra_tpu.catalog import readpipe
+        from learningorchestra_tpu.models import tune as tune_module
         from learningorchestra_tpu.utils import fitckpt
         from learningorchestra_tpu.utils.profiling import op_timer
 
@@ -760,6 +819,11 @@ class App:
                # the resumable-fit plane's health at a glance.
                "job_fault": jobs_module.fault_snapshot(),
                "fit_checkpoints": fitckpt.disk_snapshot(self.cfg),
+               # Hyperparameter-search plane: populations fitted,
+               # candidates evaluated, halving drops, HBM-budget wave
+               # spills (rendered as lo_tune_* on the exposition
+               # surface).
+               "tune": tune_module.counters_snapshot(),
                "integrity": self.store.integrity_snapshot(),
                "read_pipeline": readpipe.snapshot(),
                "serving": self.predictor.snapshot(),
@@ -957,6 +1021,16 @@ class App:
         if kind == "model_predict":
             return lambda: self.builder.predict(
                 spec["model"], spec["dataset"], spec["out"], existing=True)
+        if kind == "tune":
+            # The re-run resumes from the sweep's rung-boundary fit
+            # checkpoints (same config key), so a pod death at rung k
+            # costs rung k, not the whole population.
+            return lambda: self.builder.tune(
+                spec["train"], spec["out"], spec["classifier"],
+                spec["configs"], spec["label"],
+                steps=spec.get("steps") or (),
+                folds=spec.get("folds"), rungs=spec.get("rungs"),
+                promote=bool(spec.get("promote")), existing=True)
         return None
 
     def _rescan_failed_jobs(self) -> None:

@@ -165,6 +165,8 @@ def render(doc: Dict[str, Any]) -> str:
     for section, prefix, mtype, help_text in (
             ("read_pipeline", "lo_read_pipeline", _COUNTER,
              "Chunk-read pipeline counter"),
+            ("tune", "lo_tune", _COUNTER,
+             "Hyperparameter-search plane counter"),
             ("integrity", "lo_integrity", _COUNTER,
              "Data-plane integrity counter"),
             # Mixed live values (buffer occupancy) and monotone totals:

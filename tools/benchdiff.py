@@ -65,6 +65,12 @@ BUILTIN_TOLERANCES: List[Tuple[str, float]] = [
     ("*replication_bench*push_rps", 2.0),
     ("*replication_bench*push_mb_s", 2.0),
     ("*replication_bench*repair_duration_ms", 3.0),
+    # Hyperparameter-search A/B (PR 18): both arms are compile-heavy by
+    # design (the serial arm's recompiles ARE the measured cost), and
+    # compile time on shared rigs swings widely; the speedup ratio is
+    # steadier than either wall-clock but still rides the same noise.
+    ("*tune_bench*wall_s", 2.0),
+    ("*tune_bench*speedup", 1.5),
 ]
 
 
