@@ -4,9 +4,19 @@ Generates a multi-GB CSV on disk, ingests it under a RAM budget a
 fraction of its size, then runs the streaming histogram + projection
 pipeline — the BASELINE.md Criteo-1TB config's mechanics at a scale this
 rig's disk allows. Reports wall-clock and the resident-memory ceiling the
-catalog observed.
+catalog observed. A final block A/Bs serial vs range-partitioned ingest
+against a bandwidth-throttled local HTTP source (the regime the
+partitioned plane targets: per-connection-limited links, where N ranged
+streams approach N× aggregate throughput).
 
 Usage: python benchmarks/bench_outofcore.py [gb] [budget_mb]
+
+Smoke knobs (env): LO_BENCH_GB / LO_BENCH_BUDGET_MB override the
+positional defaults; LO_BENCH_AB_MB sizes the sharded-ingest A/B source
+prefix (default 24, 0 skips the block), LO_BENCH_INGEST_PARTITIONS its
+partition count (default 2 — the two-simulated-hosts acceptance config),
+LO_BENCH_THROTTLE_MBPS the per-connection pacing (default 2 MB/s — slow
+enough that link time dominates parse time, the regime the gate models).
 """
 
 from __future__ import annotations
@@ -15,6 +25,7 @@ import json
 import os
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -43,6 +54,114 @@ def write_csv(path: str, target_bytes: int) -> int:
             f.write(lines + "\n")
             rows += chunk
     return rows
+
+
+def _throttled_server(path: str, nbytes: int, mbps: float):
+    """Local HTTP server over ``path``'s first ``nbytes`` with HEAD +
+    Range support and PER-CONNECTION pacing: each response thread sleeps
+    to cap its own stream at ``mbps`` MB/s (time.sleep releases the GIL,
+    so N concurrent ranged streams really deliver ~N× aggregate — the
+    link model the partitioned plane is built for)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    block = 256 << 10
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):          # keep bench output clean
+            pass
+
+        def _range(self):
+            spec = self.headers.get("Range")
+            if not spec or not spec.startswith("bytes="):
+                return 0, nbytes
+            lo, _, hi = spec[len("bytes="):].partition("-")
+            start = int(lo or 0)
+            stop = min(int(hi) + 1, nbytes) if hi else nbytes
+            return start, stop
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(nbytes))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+        def do_GET(self):
+            start, stop = self._range()
+            ranged = self.headers.get("Range") is not None
+            self.send_response(206 if ranged else 200)
+            if ranged:
+                self.send_header(
+                    "Content-Range", f"bytes {start}-{stop - 1}/{nbytes}")
+            self.send_header("Content-Length", str(stop - start))
+            self.end_headers()
+            pace = block / (mbps * 1e6)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(start)
+                    pos = start
+                    while pos < stop:
+                        chunk = f.read(min(block, stop - pos))
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        pos += len(chunk)
+                        time.sleep(pace)
+            except (BrokenPipeError, ConnectionResetError):
+                pass    # partition worker closed at its stop anchor
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    srv.daemon_threads = True
+    # thread-lifecycle: daemon; dies with the bench process after shutdown
+    t = threading.Thread(target=srv.serve_forever, name="lo-bench-http",
+                         daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}/src.csv"
+
+
+def _sharded_ingest_ab(cfg, csv_path: str):
+    """Serial vs N-partition ingest of the same throttled HTTP source:
+    identical rows both arms (parity asserted), wall-clock speedup must
+    clear the 1.8× acceptance gate at the default 2 partitions."""
+    from learningorchestra_tpu.catalog.ingest import ingest_csv_url
+    from learningorchestra_tpu.catalog.store import DatasetStore
+
+    ab_mb = float(os.environ.get("LO_BENCH_AB_MB", 24))
+    if ab_mb <= 0:
+        return
+    parts = int(os.environ.get("LO_BENCH_INGEST_PARTITIONS", 2))
+    mbps = float(os.environ.get("LO_BENCH_THROTTLE_MBPS", 2))
+    nbytes = min(int(ab_mb * (1 << 20)), os.path.getsize(csv_path))
+    srv, url = _throttled_server(csv_path, nbytes, mbps)
+    try:
+        walls, rows = {}, {}
+        for arm, n_parts in (("serial", 0), ("sharded", parts)):
+            acfg = cfg.replace(
+                store_root=os.path.join(cfg.store_root, f"ab_{arm}"),
+                ingest_partitions=n_parts,
+                ingest_commit_bytes=4 << 20)   # stream commits: both arms
+                                               # overlap them with the link
+            store = DatasetStore(acfg)
+            store.create("ab", url=url)
+            t0 = time.time()
+            ingest_csv_url(store, "ab", url, acfg)
+            walls[arm] = time.time() - t0
+            rows[arm] = store.get("ab").num_rows
+        assert rows["serial"] == rows["sharded"], rows
+        speedup = walls["serial"] / walls["sharded"]
+        print(json.dumps({
+            "bench": "outofcore.sharded_ingest",
+            "serial_wall_s": round(walls["serial"], 2),
+            "sharded_wall_s": round(walls["sharded"], 2),
+            "speedup": round(speedup, 2),
+            "partitions": parts, "rows": rows["sharded"],
+            "throttle_mbps": mbps,
+        }), flush=True)
+        assert speedup >= 1.8, (
+            f"partitioned ingest speedup {speedup:.2f} below the 1.8x "
+            f"gate at {parts} partitions")
+    finally:
+        srv.shutdown()
+        srv.server_close()
 
 
 def main(gb: float = 4.0, budget_mb: int = 512):
@@ -110,7 +229,11 @@ def _run(cfg, csv_path, gb, budget_mb):
     last = store.read("big_proj", skip=ds.num_rows - 1, limit=2)
     assert last[-1]["id"] == ds.num_rows - 1
 
+    _sharded_ingest_ab(cfg, csv_path)
+
 
 if __name__ == "__main__":
-    main(float(sys.argv[1]) if len(sys.argv) > 1 else 4.0,
-         int(sys.argv[2]) if len(sys.argv) > 2 else 512)
+    main(float(sys.argv[1]) if len(sys.argv) > 1
+         else float(os.environ.get("LO_BENCH_GB", 4.0)),
+         int(sys.argv[2]) if len(sys.argv) > 2
+         else int(os.environ.get("LO_BENCH_BUDGET_MB", 512)))

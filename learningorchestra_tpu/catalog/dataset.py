@@ -880,6 +880,15 @@ class Dataset:
             return sum(c.n_rows for c in self._chunks)
 
     @property
+    def shard_map(self) -> Optional[dict]:
+        """Ownership map a range-partitioned ingest recorded (owner host →
+        contiguous row range, in global row order); None for datasets
+        ingested serially or written locally. A placement hint only —
+        reads never require it (non-local chunks stay reachable through
+        the replicate.fetch_chunk repair path)."""
+        return self.metadata.extra.get("shard_map")
+
+    @property
     def resume_offset(self) -> Optional[int]:
         """Source-stream byte offset after the last committed ingest chunk
         — where an interrupted ingest resumes. None when the dataset has

@@ -39,8 +39,9 @@ import io
 import os
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -53,6 +54,15 @@ from learningorchestra_tpu.utils import failpoints
 #: ingest resume must survive (utils/failpoints.py).
 FP_BLOCK_POST_FETCH = failpoints.declare("ingest.block.post_fetch")
 
+#: Fires at partition-worker entry, before the worker opens its ranged
+#: stream — the crash window where a host has claimed a byte partition
+#: but committed nothing of it yet.
+FP_PARTITION_PRE_CLAIM = failpoints.declare("ingest.partition.pre_claim")
+
+#: Fires after each ranged chunk a partition worker fetches — the
+#: mid-partition crash window a partition-level resume must survive.
+FP_PARTITION_MID_STREAM = failpoints.declare("ingest.partition.mid_stream")
+
 
 class InvalidCsvUrl(ValueError):
     pass
@@ -61,29 +71,66 @@ class InvalidCsvUrl(ValueError):
 _CHUNK_BYTES = 1 << 20          # 1 MiB download chunks
 _QUEUE_DEPTH = 64               # bounded: ~64 MiB in flight max
 
-_session_lock = threading.Lock()
-_session = None
+#: Parsed blocks buffered per partition worker before its fetch stalls on
+#: backpressure (the coordinator drains partitions in order, so later
+#: workers prefetch up to this many blocks ahead).
+_PARTITION_QUEUE_DEPTH = 4
+
+_session_local = threading.local()
 
 
 def _http_session():
-    """Process-wide pooled ``requests.Session``. One logical ingest can
-    hit the source several times — the HEAD identity probe, the body GET,
-    and every ranged re-fetch a resume issues — and per-call
-    ``requests.get`` pays TCP+TLS setup each time. The pooled session
-    reuses connections across all of them (and across concurrent
-    ingests; Session is thread-safe for request dispatch)."""
-    global _session
-    with _session_lock:
-        if _session is None:
-            import requests
-            from requests.adapters import HTTPAdapter
+    """Per-thread pooled ``requests.Session``. One logical ingest can hit
+    the source several times — the HEAD identity probe, the body GET, and
+    every ranged re-fetch a resume issues — and per-call ``requests.get``
+    pays TCP+TLS setup each time; the session reuses connections across
+    all of them. Per-THREAD because partitioned ingest runs N downloader
+    threads issuing concurrent ranged GETs: a process-wide Session would
+    funnel them through one shared connection-pool slot set, and
+    Session's cookie/redirect internals are not safe under concurrent
+    mutation. Thread-local sessions give each partition worker its own
+    pool at the cost of one TCP setup per (thread, host)."""
+    s = getattr(_session_local, "session", None)
+    if s is None:
+        import requests
+        from requests.adapters import HTTPAdapter
 
-            s = requests.Session()
-            adapter = HTTPAdapter(pool_connections=4, pool_maxsize=8)
-            s.mount("http://", adapter)
-            s.mount("https://", adapter)
-            _session = s
-        return _session
+        s = requests.Session()
+        adapter = HTTPAdapter(pool_connections=4, pool_maxsize=8)
+        s.mount("http://", adapter)
+        s.mount("https://", adapter)
+        _session_local.session = s
+    return s
+
+
+# --- ingest-plane counters (rendered as the /metrics `ingest` section) ---
+_counters_lock = threading.Lock()
+_counters = {
+    "partition_ingests": 0,    # partitioned runs started
+    "partition_starts": 0,     # partition workers launched
+    "partition_bytes": 0,      # source bytes fetched by partition workers
+    "partition_rows": 0,       # rows committed by partitioned runs
+    "partition_realigns": 0,   # speculative starts discarded + redone
+    "partition_resumes": 0,    # partitioned runs continuing a crashed one
+    "partition_fallbacks": 0,  # partitioned requests served serially
+}
+
+
+def bump(key: str, by: int = 1) -> None:
+    with _counters_lock:
+        _counters[key] = _counters.get(key, 0) + by
+
+
+def counters_snapshot() -> dict:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Test hook."""
+    with _counters_lock:
+        for key in _counters:
+            _counters[key] = 0
 
 #: Hard ceiling on one row-aligned block. The native tokenizer stores cell
 #: spans as uint32 with the high bit reserved (csv_parser.cpp kArenaBit)
@@ -176,12 +223,16 @@ def _close_after(resp, it: Iterator[bytes]) -> Iterator[bytes]:
         resp.close()
 
 
-def _open_url_stream(url: str, timeout: float,
-                     offset: int = 0) -> Iterator[bytes]:
+def _open_url_stream(url: str, timeout: float, offset: int = 0,
+                     chunk_bytes: int = 0) -> Iterator[bytes]:
     """Yield byte chunks from a URL (http(s)://) or local file (file:// or
     bare path — used by tests and the bench harness), optionally starting
     at a byte offset (ingest resume). HTTP uses a Range request, falling
-    back to skip-reading when the server ignores it."""
+    back to skip-reading when the server ignores it. ``chunk_bytes``
+    overrides the 1 MiB default chunk size — the partitioned header sniff
+    reads small chunks so it isn't charged a megabyte of link time for
+    one record."""
+    chunk_bytes = chunk_bytes or _CHUNK_BYTES
     if url.startswith(("http://", "https://")):
         # identity: byte offsets journal positions in the DECODED stream
         # (iter_content gunzips transparently), but a Range request
@@ -213,7 +264,7 @@ def _open_url_stream(url: str, timeout: float,
                     resp.close()
                     raise
                 return _close_after(resp, _skip_bytes(
-                    resp.iter_content(chunk_size=_CHUNK_BYTES), offset))
+                    resp.iter_content(chunk_size=chunk_bytes), offset))
             raise SourceChanged(
                 f"source at {url} is {total} bytes, shorter than the "
                 f"committed resume offset {offset}; it must have changed "
@@ -223,7 +274,7 @@ def _open_url_stream(url: str, timeout: float,
         except Exception:
             resp.close()
             raise
-        it = resp.iter_content(chunk_size=_CHUNK_BYTES)
+        it = resp.iter_content(chunk_size=chunk_bytes)
         if offset and resp.status_code != 206:
             it = _skip_bytes(it, offset)
         return _close_after(resp, it)
@@ -234,7 +285,7 @@ def _open_url_stream(url: str, timeout: float,
             if offset:
                 f.seek(offset)
             while True:
-                chunk = f.read(_CHUNK_BYTES)
+                chunk = f.read(chunk_bytes)
                 if not chunk:
                     return
                 yield chunk
@@ -353,6 +404,15 @@ def resume_ingest(store: DatasetStore, name: str, cfg=None) -> None:
 
 def _run_ingest(store: DatasetStore, name: str, url: str, cfg,
                 start_offset: Optional[int]) -> None:
+    # Range-partitioned path: opt-in (LO_TPU_INGEST_PARTITIONS > 1), and
+    # only when the source advertises its length — _run_partitioned_ingest
+    # declines (returns False) for unsized sources or ranges too small to
+    # split, falling through to the serial path below, byte-for-byte the
+    # pre-partitioning behavior.
+    n_parts = getattr(cfg, "ingest_partitions", 0) or 0
+    if n_parts > 1 and _run_partitioned_ingest(store, name, url, cfg,
+                                               start_offset, n_parts):
+        return
     ds = store.get(name)
     resuming = start_offset is not None and start_offset > 0
     fields = list(ds.metadata.fields) if resuming else None
@@ -578,6 +638,451 @@ def _pipeline(store, ds, name: str, chunks_q, pool, commit_pool,
         commit_fut = None
     if cfg.persist:
         store.save(name)
+
+
+# --- range-partitioned ingest -------------------------------------------
+#
+# The byte range [body_start, length) is split into one contiguous
+# partition per pod host. Each partition worker streams its own ranged
+# fetch, record-aligns, and parses concurrently; the coordinator appends
+# partitions' blocks IN PARTITION ORDER, so global row order equals the
+# serial oracle's and the journal's monotone ``src_off`` chain — and with
+# it the resume machinery — carries over unchanged.
+#
+# Record alignment is speculative: worker i>0 anchors one byte before its
+# range (so a record starting exactly at the boundary stays in partition
+# i) and scans forward with _first_record_end ASSUMING even quote parity
+# at the anchor. Its records are exact iff that assumption held — which
+# the coordinator verifies for free: a partition's actual first record
+# start must equal the previous partition's actual stop (the offset
+# chain). On a mismatch (the anchor fell inside a quoted field), the
+# partition's speculative output is discarded and the range re-ingested
+# from the now-known true record start. The result is bit-identical row
+# content to the serial path in every case, at full overlap in the
+# overwhelmingly common aligned one.
+
+
+def _partition_ranges(start: int, length: int, parts: int,
+                      min_bytes: int) -> List[Tuple[int, int]]:
+    """Split [start, length) into up to ``parts`` contiguous byte ranges,
+    never smaller than ``min_bytes`` (tiny sources don't amortize a
+    second connection)."""
+    span = max(0, length - start)
+    if span <= 0:
+        return []
+    if min_bytes > 0:
+        parts = min(parts, max(1, span // min_bytes))
+    parts = max(1, int(parts))
+    bounds = [start + (span * i) // parts for i in range(parts + 1)]
+    return [(bounds[i], bounds[i + 1]) for i in range(parts)
+            if bounds[i + 1] > bounds[i]]
+
+
+def _parsed_rows(parsed) -> int:
+    if isinstance(parsed, dict):
+        return len(next(iter(parsed.values()))) if parsed else 0
+    return int(parsed.num_rows)
+
+
+def _partition_worker(url: str, cfg, begin: int, stop_anchor: Optional[int],
+                      length: int, fields: List[str], exact_start: bool,
+                      out_q: "queue.Queue", cancel: threading.Event) -> None:
+    """Fetch + record-align + parse one byte partition.
+
+    Emits, in order: ``("start", abs_off)`` — the absolute offset of the
+    partition's first record (speculative unless ``exact_start``); then
+    ``("block", parsed, src_end_abs)`` per row-aligned block; then
+    ``("done", stop_abs)``. Any failure emits ``("error", exc)``.
+
+    The stop rule mirrors what the next partition's start rule selects:
+    a non-last partition consumes through the first record end at
+    absolute position >= ``stop_anchor`` (one byte before the next
+    range), so adjacent aligned partitions tile the stream exactly. The
+    last partition (``stop_anchor is None``) runs to EOF, torn final
+    record included.
+    """
+    try:
+        failpoints.fire(FP_PARTITION_PRE_CLAIM)
+
+        def put(item) -> bool:
+            while not cancel.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        anchor = begin if exact_start else begin - 1
+        stream = _open_url_stream(url, cfg.download_timeout, offset=anchor)
+        try:
+            buf = bytearray()
+            base = anchor
+            eof = False
+
+            def read_more() -> bool:
+                nonlocal eof
+                if eof or cancel.is_set():
+                    return False
+                try:
+                    chunk = next(stream)
+                except StopIteration:
+                    eof = True
+                    return False
+                buf.extend(chunk)
+                bump("partition_bytes", len(chunk))
+                failpoints.fire(FP_PARTITION_MID_STREAM)
+                return True
+
+            # -- phase A: locate this partition's first record start ------
+            if exact_start:
+                start_abs = begin
+            else:
+                nl, scanned, q = _first_record_end(buf)
+                while nl < 0 and read_more():
+                    if len(buf) > _MAX_BLOCK_BYTES:
+                        raise ValueError(
+                            "no record boundary within "
+                            f"{_MAX_BLOCK_BYTES} bytes after partition "
+                            f"anchor {anchor} — unbalanced quote in the "
+                            "CSV?")
+                    nl, scanned, q = _first_record_end(buf, scanned, q)
+                if cancel.is_set():
+                    return
+                if nl < 0:
+                    # EOF with no record end at/after the anchor: the
+                    # range holds zero record starts (the stream's tail is
+                    # an earlier partition's torn final record).
+                    put(("start", length))
+                    put(("done", length))
+                    return
+                start_abs = base + nl + 1
+                del buf[:nl + 1]
+                base = start_abs
+            if not put(("start", start_abs)):
+                return
+
+            approx_row = max(32, len(",".join(fields)) + 8)
+            target = max(cfg.ingest_chunk_rows * approx_row, 1 << 12)
+
+            # -- phase B: free row-aligned cuts strictly below the stop
+            # anchor (any record end there is safely ours) ---------------
+            while not cancel.is_set():
+                # Fill toward the block target but never fetch meaningfully
+                # past the stop anchor — bytes beyond it belong to the next
+                # partition's stream and would be paid for twice.
+                need = target if stop_anchor is None else min(
+                    target, stop_anchor - base + 1)
+                while len(buf) < need and read_more():
+                    pass
+                limit = len(buf) if stop_anchor is None else min(
+                    len(buf), stop_anchor - base)
+                if limit <= 0:
+                    break
+                window = min(target, limit)
+                cut = _record_split(buf, window, cfg)
+                if cut < 0 and limit > window:
+                    # record longer than target: search the whole window
+                    cut = _record_split(buf, limit, cfg)
+                if cut < 0:
+                    if stop_anchor is not None and limit < len(buf):
+                        break       # next record end is past the anchor
+                    if eof:
+                        break
+                    if target >= _MAX_BLOCK_BYTES:
+                        raise ValueError(
+                            "no record boundary within "
+                            f"{_MAX_BLOCK_BYTES} bytes near source offset "
+                            f"{base} — unbalanced quote in the CSV?")
+                    target = min(target * 2, _MAX_BLOCK_BYTES)
+                    continue
+                block = bytes(buf[:cut + 1])
+                del buf[:cut + 1]
+                base += len(block)
+                if not put(("block", _parse_block(block, fields, cfg),
+                            base)):
+                    return
+            if cancel.is_set():
+                return
+
+            # -- phase C: non-last partitions stop at the first record end
+            # at/after the stop anchor (matching the next partition's
+            # start rule), streaming past the nominal range end to it ----
+            if stop_anchor is not None:
+                nl, scanned, q = _first_record_end(buf)
+                while not cancel.is_set():
+                    while 0 <= nl and base + nl < stop_anchor:
+                        nl, scanned, q = _first_record_end(buf, scanned, q)
+                    if nl >= 0 or eof:
+                        break
+                    if len(buf) > _MAX_BLOCK_BYTES:
+                        raise ValueError(
+                            "no record boundary within "
+                            f"{_MAX_BLOCK_BYTES} bytes near source offset "
+                            f"{base} — unbalanced quote in the CSV?")
+                    read_more()
+                    nl, scanned, q = _first_record_end(buf, scanned, q)
+                if cancel.is_set():
+                    return
+                if nl >= 0:
+                    block = bytes(buf[:nl + 1])
+                    del buf[:nl + 1]
+                    base += len(block)
+                    if not put(("block", _parse_block(block, fields, cfg),
+                                base)):
+                        return
+                    put(("done", base))
+                    return
+                # EOF before the stop record end: this partition owns the
+                # stream's tail — fall through to phase D.
+
+            # -- phase D: consume the tail to EOF (torn final record) ----
+            while buf:
+                if cancel.is_set():
+                    return
+                cut = _record_split(buf, len(buf), cfg)
+                if cut < 0:
+                    if not buf.strip():
+                        base += len(buf)    # blank tail: consumed, no rows
+                        buf.clear()
+                        break
+                    cut = len(buf) - 1      # torn final record
+                block = bytes(buf[:cut + 1])
+                del buf[:cut + 1]
+                base += len(block)
+                if not put(("block", _parse_block(block, fields, cfg),
+                            base)):
+                    return
+            put(("done", base))
+        finally:
+            close = getattr(stream, "close", None)
+            if close:
+                close()
+    except Exception as exc:  # noqa: BLE001 — forwarded to coordinator
+        try:
+            out_q.put(("error", exc), timeout=1.0)
+        except queue.Full:
+            pass
+
+
+def _drain_worker(t: threading.Thread, wq: "queue.Queue") -> None:
+    """Discard a worker's buffered output and reap it. The worker's
+    cancel event must already be set, so its next put/read bails and the
+    drain terminates."""
+    deadline = time.monotonic() + 10.0
+    while t.is_alive() and time.monotonic() < deadline:
+        try:
+            wq.get(timeout=0.05)
+        except queue.Empty:
+            pass
+    t.join(timeout=5.0)
+    while True:
+        try:
+            wq.get_nowait()
+        except queue.Empty:
+            break
+
+
+def _fetch_header(url: str, cfg):
+    """Fetch just the header record of a fresh partitioned ingest:
+    ``(fields, body_start)``, or None when the source has no complete
+    header (empty / unbalanced — the serial path owns those edges). Small
+    chunks: on a throttled link a 1 MiB first read would serialize a
+    megabyte of wait in front of every partition worker."""
+    stream = _open_url_stream(url, cfg.download_timeout,
+                              chunk_bytes=64 << 10)
+    buf = bytearray()
+    nl, scanned, hq = -1, 0, 0
+    first = True
+    try:
+        for chunk in stream:
+            if first:
+                _sniff_header(chunk, url)
+                first = False
+            buf.extend(chunk)
+            nl, scanned, hq = _first_record_end(buf, scanned, hq)
+            if nl >= 0:
+                break
+            if len(buf) > _MAX_BLOCK_BYTES:
+                return None
+    finally:
+        close = getattr(stream, "close", None)
+        if close:
+            close()
+    if nl < 0:
+        return None
+    header = bytes(buf[:nl + 1])
+    text = header.decode("utf-8", errors="replace").strip("\r\n﻿")
+    return next(csv.reader([text])), len(header)
+
+
+def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
+                            start_offset: Optional[int],
+                            n_parts: int) -> bool:
+    """Range-partitioned ingest (see the section comment above). Returns
+    False — committing nothing — when the source can't be partitioned
+    (no advertised length, or a range too small to split), in which case
+    the caller falls through to the serial path."""
+    ds = store.get(name)
+    resuming = start_offset is not None and start_offset > 0
+    identity = _source_identity(url, cfg.download_timeout)
+    length = identity.get("length")
+    if length is None:
+        bump("partition_fallbacks")
+        return False
+    if resuming:
+        fields = list(ds.metadata.fields)
+        if not fields:
+            raise ValueError(
+                f"dataset {name} has a resume offset but no recorded "
+                "fields")
+        body_start = int(start_offset)
+        pre_rows = ds.num_rows
+        bump("partition_resumes")
+    else:
+        got = _fetch_header(url, cfg)
+        if got is None:
+            bump("partition_fallbacks")
+            return False
+        fields, body_start = got
+        ds.metadata.extra["source_id"] = identity
+        pre_rows = 0
+    min_bytes = getattr(cfg, "ingest_partition_min_bytes", 0) or 0
+    ranges = _partition_ranges(body_start, length, n_parts, min_bytes)
+    if len(ranges) <= 1:
+        bump("partition_fallbacks")
+        return False
+
+    bump("partition_ingests")
+    workers = []
+    for i, (b, _e) in enumerate(ranges):
+        nxt = ranges[i + 1][0] - 1 if i + 1 < len(ranges) else None
+        wq: "queue.Queue" = queue.Queue(maxsize=_PARTITION_QUEUE_DEPTH)
+        wc = threading.Event()
+        # thread-lifecycle: owner=_run_partitioned_ingest; exits when its
+        # byte range is drained (terminal "done"/"error" queue item) or
+        # the coordinator cancels it (realign/teardown sets its event) —
+        # every exception is forwarded through the queue to the
+        # coordinator, never left to die uncaught; daemon.
+        t = threading.Thread(
+            target=_partition_worker,
+            args=(url, cfg, b, nxt, length, fields, i == 0, wq, wc),
+            daemon=True, name=f"lo-ingest-p{i}")
+        t.start()
+        bump("partition_starts")
+        workers.append((t, wq, wc, nxt))
+
+    commit_pool = ThreadPoolExecutor(max_workers=1,
+                                     thread_name_prefix="lo-ingest-commit")
+    commit_fut = None
+    pending_bytes = 0
+    commit_every = cfg.ingest_commit_bytes
+    redo: list = []              # (thread, queue, event) realign re-runs
+
+    def consume(q_in: "queue.Queue") -> Tuple[int, int]:
+        """Drain one validated partition in order, appending every block
+        and batching commits exactly like the serial committer; returns
+        (rows, stop_abs)."""
+        nonlocal commit_fut, pending_bytes
+        rows = 0
+        while True:
+            item = q_in.get()
+            kind = item[0]
+            if kind == "error":
+                raise item[1]
+            if kind == "done":
+                return rows, item[1]
+            _, parsed, src_end = item
+            rows += _parsed_rows(parsed)
+            pending_bytes += _append_parsed(ds, parsed, src_end)
+            if cfg.persist and (not commit_every
+                                or pending_bytes >= commit_every):
+                if commit_fut is not None:
+                    commit_fut.result()
+                commit_fut = commit_pool.submit(store.save, name)
+                pending_bytes = 0
+
+    part_rows: List[int] = []
+    part_spans: List[Tuple[int, int]] = []
+    expected = body_start        # the offset-chain invariant
+    try:
+        for i, (t, wq, wc, nxt) in enumerate(workers):
+            item = wq.get()
+            if item[0] == "error":
+                raise item[1]
+            start_abs = item[1]
+            if start_abs == expected:
+                rows_i, stop = consume(wq)
+            else:
+                # Misaligned speculation: the anchor fell inside a quoted
+                # field, so the worker's assumed parity — and every cut
+                # derived from it — is wrong. Discard and re-ingest the
+                # range from the true record start the chain gives us.
+                bump("partition_realigns")
+                wc.set()
+                _drain_worker(t, wq)
+                hi = nxt + 1 if nxt is not None else length
+                if expected >= hi:
+                    # A record spanning this whole range was already
+                    # consumed by the previous partition; nothing left.
+                    part_rows.append(0)
+                    part_spans.append((expected, expected))
+                    continue
+                rq: "queue.Queue" = queue.Queue(
+                    maxsize=_PARTITION_QUEUE_DEPTH)
+                rc = threading.Event()
+                # thread-lifecycle: owner=_run_partitioned_ingest; redo
+                # worker for a misaligned partition — exits on its
+                # terminal queue item or teardown cancel; daemon.
+                rt = threading.Thread(
+                    target=_partition_worker,
+                    args=(url, cfg, expected, nxt, length, fields, True,
+                          rq, rc),
+                    daemon=True, name=f"lo-ingest-r{i}")
+                rt.start()
+                redo.append((rt, rq, rc))
+                first = rq.get()
+                if first[0] == "error":
+                    raise first[1]
+                rows_i, stop = consume(rq)
+            part_rows.append(rows_i)
+            part_spans.append((expected, stop))
+            expected = stop
+        if commit_fut is not None:
+            commit_fut.result()
+            commit_fut = None
+        if cfg.persist:
+            store.save(name)
+    finally:
+        for t, wq, wc, _n in workers:
+            wc.set()
+        for rt, rq, rc in redo:
+            rc.set()
+        for t, wq, wc, _n in workers:
+            _drain_worker(t, wq)
+        for rt, rq, rc in redo:
+            _drain_worker(rt, rq)
+        commit_pool.shutdown(wait=True)
+
+    total_rows = sum(part_rows)
+    parts_meta = []
+    row0 = 0
+    if pre_rows:
+        # Rows committed before this (resumed) run are attributed to the
+        # first partition's owner so the shard map stays a complete
+        # contiguous cover of the row space.
+        parts_meta.append({"host": 0, "row_start": 0, "rows": int(pre_rows),
+                           "src_start": 0, "src_stop": int(body_start)})
+        row0 = int(pre_rows)
+    for i, (nrows, (s0, s1)) in enumerate(zip(part_rows, part_spans)):
+        parts_meta.append({"host": i, "row_start": row0, "rows": int(nrows),
+                           "src_start": int(s0), "src_stop": int(s1)})
+        row0 += int(nrows)
+    store.install_shard_map(name, {"hosts": len(ranges),
+                                   "partitions": parts_meta})
+    store.finish(name)
+    bump("partition_rows", int(total_rows))
+    return True
 
 
 def parse_csv_chunks(fileobj, chunk_rows: int, cfg=None):

@@ -111,6 +111,30 @@ def bump(key: str, by: int = 1) -> None:
         _counters[key] += by
 
 
+# --- shard-placement counters -------------------------------------------
+# Written by mesh.shard_chunked's placement planner: rows of each
+# addressable shard's feed classified against the dataset's ingest shard
+# map as host-local vs peer-resident. The local fraction
+# (local / (local + remote)) is THE placement health signal — an aligned
+# feed over a sharded dataset should sit near 1.0.
+_shard_counters = {
+    "local_reads": 0,
+    "remote_reads": 0,
+}
+
+
+def bump_shard(key: str, by: int = 1) -> None:
+    with _lock:
+        _shard_counters[key] += by
+
+
+def shard_snapshot() -> Dict[str, int]:
+    """Placement counter snapshot for ``GET /metrics`` (``shard``
+    section; rendered as ``lo_shard_*_total``)."""
+    with _lock:
+        return dict(_shard_counters)
+
+
 def cache_probe() -> Tuple[int, int]:
     """Current (cache_hits, cache_misses) totals — scan instrumentation
     (the ``readpipe.materialize`` span) diffs two probes to attribute a
@@ -139,6 +163,8 @@ def reset() -> None:
         _cache_bytes = 0
         for k in _counters:
             _counters[k] = 0
+        for k in _shard_counters:
+            _shard_counters[k] = 0
 
 
 def _evict_to_locked(budget: int) -> None:

@@ -26,6 +26,13 @@ scrub_probe     primary -> peer: which of these (file, crc) pairs do you
 Layering: this module sits beside dataset.py (it imports only the chunk
 CRC helpers and the shared framing) — store.py owns the policy of *when*
 to push and *where* repairs come from.
+
+Sharded (range-partition-ingested) datasets need nothing extra from this
+plane: the shard map lives in ``metadata.extra``, so it rides the
+``journal_sync`` metadata doc to every peer, and a host reading rows it
+doesn't own locally fetches them through the same ``fetch_chunk`` frames
+remote repair uses — placement (parallel/mesh.py) is a hint layered on
+top, never a correctness dependency.
 """
 
 from __future__ import annotations

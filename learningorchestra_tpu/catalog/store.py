@@ -45,6 +45,7 @@ FP_MIRROR_PRE_COPY = failpoints.declare("store.mirror.pre_copy")
 FP_FINISH_PRE_SAVE = failpoints.declare("store.finish.pre_save")
 FP_SAVE_PRE_META_SWAP = failpoints.declare("store.save.pre_meta_swap")
 FP_REPAIR_PRE_INSTALL = failpoints.declare("store.repair.pre_install")
+FP_SHARDMAP_PRE_SWAP = failpoints.declare("store.shardmap.pre_swap")
 
 
 class DatasetNotFound(KeyError):
@@ -292,6 +293,21 @@ class DatasetStore:
         ds.metadata.extra.update(extra)
         ds.metadata.finished = True
         failpoints.fire(FP_FINISH_PRE_SAVE)
+        if self.cfg.persist:
+            self.save(name)
+
+    def install_shard_map(self, name: str, shard_map: Dict[str, Any]) -> None:
+        """Record a range-partitioned ingest's ownership map (owner host →
+        contiguous row range; global row order = partition order) in the
+        dataset's metadata, where it rides the atomic ``save`` swap and
+        the ``journal_sync`` metadata doc to replica peers. The map is a
+        pure placement hint: a crash in the window before the metadata
+        swap (the failpoint below) leaves a dataset that is fully
+        readable and resumable, merely unplanned — ``mesh.shard_chunked``
+        treats a missing map as unsharded."""
+        ds = self.get(name)
+        ds.metadata.extra["shard_map"] = shard_map
+        failpoints.fire(FP_SHARDMAP_PRE_SWAP)
         if self.cfg.persist:
             self.save(name)
 

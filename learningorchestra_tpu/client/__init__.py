@@ -358,10 +358,15 @@ class _ServiceClient:
 class DatabaseApi(_ServiceClient):
     """Dataset CRUD (reference __init__.py:55-101)."""
 
-    def create_file(self, filename: str, url: str,
-                    wait: bool = False) -> Dict:
-        resp = self.context.post("/files",
-                                 json={"filename": filename, "url": url})
+    def create_file(self, filename: str, url: str, wait: bool = False,
+                    partitions: Optional[int] = None) -> Dict:
+        """``partitions`` opts this ingest into the server's
+        range-partitioned path (N concurrent per-host byte-range
+        fetches); None defers to the server's configured default."""
+        body: Dict = {"filename": filename, "url": url}
+        if partitions is not None:
+            body["partitions"] = int(partitions)
+        resp = self.context.post("/files", json=body)
         out = ResponseTreat.treatment(resp)
         if wait:
             self.waiter.wait(filename)

@@ -151,6 +151,22 @@ class Settings:
     ingest_commit_bytes: int = field(
         default_factory=lambda: _env("LO_TPU_INGEST_COMMIT_BYTES", 64 << 20)
     )
+    #: Range-partitioned ingest: split the source byte range into this
+    #: many per-host partitions fetched/parsed/journaled concurrently
+    #: (catalog/ingest.py). 0 or 1 = today's single-stream path,
+    #: byte-for-byte. Only applies when the source advertises its length
+    #: (HEAD Content-Length, or file size); unsized sources fall back to
+    #: the serial path.
+    ingest_partitions: int = field(
+        default_factory=lambda: _env("LO_TPU_INGEST_PARTITIONS", 0)
+    )
+    #: Minimum partition size in bytes: sources smaller than
+    #: 2 * this never split (a second ranged connection costs more than
+    #: it overlaps on small files).
+    ingest_partition_min_bytes: int = field(
+        default_factory=lambda: _env("LO_TPU_INGEST_PARTITION_MIN_BYTES",
+                                     4 << 20)
+    )
 
     # --- kernels -----------------------------------------------------------
     #: Use hand-written Pallas kernels for hot inner loops (t-SNE repulsion;
@@ -715,3 +731,13 @@ def failpoint_spec() -> str:
     (``LO_TPU_FAILPOINTS=site=mode[:nth],...``), read at
     utils/failpoints.py import — before any Settings exists."""
     return os.environ.get("LO_TPU_FAILPOINTS", "")
+
+
+def shard_host() -> Optional[int]:
+    """Explicit placement identity of this host for shard-map planning
+    (``LO_TPU_SHARD_HOST``): which ingest-partition owner's chunks count
+    as host-local when ``mesh.shard_chunked`` classifies its feed. None =
+    unset — multi-process pods use the jax process index, single-process
+    sims model the pod topology (parallel/spmd.local_host_id)."""
+    raw = os.environ.get("LO_TPU_SHARD_HOST")
+    return int(raw) if raw is not None and raw != "" else None

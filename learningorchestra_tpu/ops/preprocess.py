@@ -678,6 +678,16 @@ class ChunkedDesign:
     def nbytes(self) -> int:
         return self.shape[0] * self.shape[1] * 4
 
+    @property
+    def shard_map(self):
+        """The backing dataset's ingest shard map (owner host → row
+        range), surfaced so ``mesh.shard_chunked`` can plan host-local
+        placement for this design's feed; None when the dataset was not
+        range-partition ingested. Design rows map 1:1 onto dataset rows
+        (pipelines are row-wise), so the dataset's row ownership IS the
+        design's."""
+        return self.ds.shard_map
+
     def rows(self, start: int, stop: int) -> np.ndarray:
         start = max(0, int(start))
         stop = min(int(stop), self.shape[0])

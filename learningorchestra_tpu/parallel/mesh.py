@@ -109,6 +109,57 @@ def shard_rows(mesh: Mesh, arr: np.ndarray) -> Tuple[jax.Array, int]:
     return out, n
 
 
+def _plan_placement(ranges, n_rows: int, shard_map) -> None:
+    """Classify each addressable shard's row range against the dataset's
+    ingest shard map (owner host → contiguous row range, recorded by the
+    range-partitioned ingest in catalog/ingest.py): rows whose owning
+    host is the host that will read them count local, the rest remote —
+    readpipe's ``lo_shard_local_reads_total`` / ``_remote_reads_total``,
+    whose local fraction is THE placement health signal. An aligned feed
+    (devices in partition order over a partition-aligned dataset) plans
+    ~1.0 local, with only boundary tails remote; those tails still read
+    correctly through the replicate.fetch_chunk repair path.
+
+    On a real multi-process pod every range here is addressed by THIS
+    host (``spmd.local_host_id``) — as it is under an explicit
+    ``LO_TPU_SHARD_HOST``. A single-process sim addresses every device,
+    so it models the pod topology instead: consecutive devices per host,
+    range k of D read by host k*H//D."""
+    if not shard_map:
+        return
+    parts = shard_map.get("partitions") or []
+    hosts = max(1, int(shard_map.get("hosts") or 1))
+    if not parts:
+        return
+    from learningorchestra_tpu import config as _config
+    from learningorchestra_tpu.catalog import readpipe
+    from learningorchestra_tpu.parallel import spmd
+
+    pinned = _config.shard_host() is not None or jax.process_count() > 1
+    n_ranges = max(1, len(ranges))
+    local_total = 0
+    remote_total = 0
+    for k, (start, stop) in enumerate(ranges):
+        start, stop = int(start), min(int(stop), n_rows)
+        if stop <= start:
+            continue
+        reader = (spmd.local_host_id() if pinned
+                  else (k * hosts) // n_ranges)
+        local = 0
+        for p in parts:
+            if int(p.get("host", -1)) != reader:
+                continue
+            r0 = int(p.get("row_start", 0))
+            r1 = r0 + int(p.get("rows", 0))
+            local += max(0, min(stop, r1) - max(start, r0))
+        local_total += local
+        remote_total += (stop - start) - local
+    if local_total:
+        readpipe.bump_shard("local_reads", local_total)
+    if remote_total:
+        readpipe.bump_shard("remote_reads", remote_total)
+
+
 def shard_chunked(mesh: Mesh, design,
                   prefetch: Optional[int] = None) -> Tuple[jax.Array, int]:
     """Row-shard a LAZY design matrix (ops/preprocess.ChunkedDesign
@@ -168,6 +219,7 @@ def shard_chunked(mesh: Mesh, design,
         if key not in seen:
             seen.add(key)
             order.append(key)
+    _plan_placement(order, n, getattr(design, "shard_map", None))
     depth = min(2, readpipe.prefetch_depth(prefetch))
     if depth <= 0 or len(order) <= 1:
         out = jax.make_array_from_callback(

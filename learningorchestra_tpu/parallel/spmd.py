@@ -62,6 +62,21 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def local_host_id() -> int:
+    """This host's placement identity for shard-map planning — which
+    ingest-partition owner's chunks count as host-local when
+    ``mesh.shard_chunked`` classifies its feed (catalog/ingest.py records
+    the map; mesh.py consumes it). ``LO_TPU_SHARD_HOST`` overrides
+    explicitly (tests / asymmetric pods); otherwise the jax process
+    index, which matches partition order because both follow pod rank."""
+    override = _config.shard_host()
+    if override is not None:
+        return override
+    import jax
+
+    return jax.process_index()
+
+
 def serialize_collectives(tree) -> None:
     """Order-fence for back-to-back dispatched collective programs on a
     multi-process CPU pod: blocks until ``tree``'s device work completes

@@ -322,10 +322,17 @@ class App:
         @self._route("POST", "/files")
         def create_file(req):
             filename, url = req.require("filename", "url")
+            # Optional per-request override of the range-partitioned
+            # ingest fan-out (LO_TPU_INGEST_PARTITIONS supplies the
+            # default); 0/1 forces the serial path for this file.
+            partitions = req.body.get("partitions")
+            cfg = app.cfg
+            if partitions is not None:
+                cfg = cfg.replace(ingest_partitions=int(partitions))
             app.store.create(filename, url=url)
             app.jobs.submit(
                 "ingest", filename,
-                lambda: ingest_csv_url(app.store, filename, url, app.cfg))
+                lambda: ingest_csv_url(app.store, filename, url, cfg))
             return 201, {"result": f"file {filename} created",
                          "filename": filename}
 
@@ -802,6 +809,7 @@ class App:
         back in the same document, so an alert can never fire on a
         number the operator cannot see."""
         from learningorchestra_tpu import jobs as jobs_module
+        from learningorchestra_tpu.catalog import ingest as ingest_module
         from learningorchestra_tpu.catalog import readpipe
         from learningorchestra_tpu.models import tune as tune_module
         from learningorchestra_tpu.utils import fitckpt
@@ -826,6 +834,12 @@ class App:
                "tune": tune_module.counters_snapshot(),
                "integrity": self.store.integrity_snapshot(),
                "read_pipeline": readpipe.snapshot(),
+               # Range-partitioned ingest plane (lo_ingest_partition_*)
+               # and the shard-placement planner's local/remote feed
+               # classification (lo_shard_*_total) — the local fraction
+               # is the placement health signal.
+               "ingest": ingest_module.counters_snapshot(),
+               "shard": readpipe.shard_snapshot(),
                "serving": self.predictor.snapshot(),
                "tracing": tracing.counters_snapshot(),
                # The span-taxonomy aggregation: per-model queue-wait /

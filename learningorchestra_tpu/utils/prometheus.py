@@ -169,12 +169,24 @@ def render(doc: Dict[str, Any]) -> str:
              "Hyperparameter-search plane counter"),
             ("integrity", "lo_integrity", _COUNTER,
              "Data-plane integrity counter"),
+            ("ingest", "lo_ingest", _COUNTER,
+             "Range-partitioned ingest plane counter"),
             # Mixed live values (buffer occupancy) and monotone totals:
             # gauge is the honest common type.
             ("tracing", "lo_trace", _GAUGE, "Tracing subsystem metric")):
         sec = doc.get(section) or {}
         if sec:
             _flat_counters(w, prefix, sec, mtype, help_text)
+
+    shard = doc.get("shard") or {}
+    if shard:
+        for key in ("local_reads", "remote_reads"):
+            name = f"lo_shard_{key}_total"
+            w.header(name, _COUNTER,
+                     f"Shard-placement planner {key.replace('_', ' ')} "
+                     "(rows of shard_chunked feed classified against the "
+                     "dataset shard map)")
+            w.sample(name, None, shard.get(key, 0))
 
     rep = doc.get("replication") or {}
     if rep.get("enabled"):

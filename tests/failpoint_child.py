@@ -45,6 +45,19 @@ csv_path = os.path.join(root, "src.csv")
 store.create("ing", url=csv_path)
 ingest_csv_url(store, "ing", csv_path, cfg)
 
+# -- 1b. range-partitioned ingest of the same source --------------------------
+# Hits: ingest.partition.pre_claim (partition-worker claim),
+# ingest.partition.mid_stream (each fetched range chunk), and
+# store.shardmap.pre_swap (the shard-map install between the last
+# partition commit and the finish flip). min_bytes=1 forces a real
+# 2-way split on the small source; the journal/chunk-write sites fire
+# again but were already spent by stage 1 if armed.
+pcfg = cfg.replace(ingest_partitions=2, ingest_partition_min_bytes=1)
+store.create("pshard", url=csv_path)
+ingest_csv_url(store, "pshard", csv_path, pcfg)
+n_pshard = store.get("pshard").num_rows
+assert store.get("pshard").shard_map is not None
+
 # -- 2. append + coercion rewrite ---------------------------------------------
 # Hits: catalog.write_chunk.pre_rename / journal.mid_append again on the
 # appends, then catalog.journal.pre_swap on the set_column generation
@@ -64,6 +77,7 @@ store.finish("tab")
 # reads).
 store2 = DatasetStore(cfg)
 store2.load("ing")
+store2.load("pshard")
 store2.load("tab")
 n_ing = len(next(iter(store2.get("ing").columns.values())))
 n_tab = len(next(iter(store2.get("tab").columns.values())))
@@ -120,4 +134,5 @@ rstore2.stop_replication()
 peer.stop()
 
 with open(os.path.join(root, "done.json"), "w") as f:
-    json.dump({"ing_rows": n_ing, "tab_rows": n_tab, "rep_rows": len(rx)}, f)
+    json.dump({"ing_rows": n_ing, "tab_rows": n_tab, "rep_rows": len(rx),
+               "pshard_rows": n_pshard}, f)

@@ -70,8 +70,11 @@ def test_registry_has_the_contract_sites():
                  "catalog.journal.pre_swap",
                  "catalog.chunk.pre_read",
                  "ingest.block.post_fetch",
+                 "ingest.partition.pre_claim",
+                 "ingest.partition.mid_stream",
                  "store.mirror.pre_copy",
-                 "store.finish.pre_save"):
+                 "store.finish.pre_save",
+                 "store.shardmap.pre_swap"):
         assert site in got
     # spmd declares lazily safe at import of the parallel package.
     from learningorchestra_tpu.parallel import spmd  # noqa: F401
@@ -502,6 +505,7 @@ def test_control_child_completes(tmp_path):
         done = json.load(f)
     assert done["tab_rows"] == 200 and done["ing_rows"] == 2000
     assert done["rep_rows"] == 256   # remote repair healed the chunk loss
+    assert done["pshard_rows"] == 2000   # partitioned ingest == oracle
     _assert_peer_replica_consistent(root)
 
 
@@ -546,6 +550,8 @@ def test_crash_sweep_recovers_to_journaled_prefix(tmp_path, site):
     # prefix bound: never MORE rows than the completed control workload
     if "ing" in loaded:
         assert store.get("ing").num_rows <= 2000
+    if "pshard" in loaded:
+        assert store.get("pshard").num_rows <= 2000
     if "tab" in loaded:
         assert store.get("tab").num_rows <= 200
     # the recovered store stays fully usable

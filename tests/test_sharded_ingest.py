@@ -1,0 +1,370 @@
+"""Range-partitioned parallel ingest + host-local shard placement.
+
+Four layers of proof for the sharded ingest plane (ISSUE 20):
+
+1. parity: N-partition ingest is bit-identical to the serial oracle —
+   including the adversarial record-alignment cases (quoted embedded
+   newlines that defeat the speculative start and force a realign, CRLF
+   endings, a record spanning the split point, and a giant record that
+   swallows an entire middle partition so it has NO record start);
+2. default-off: ``LO_TPU_INGEST_PARTITIONS`` unset keeps today's serial
+   path byte-for-byte (the partitioned entry point is never reached);
+3. placement: ``shard_chunked`` over a 2-partition dataset plans ≥95 %
+   of its feed rows host-local on the modeled pod topology, and a
+   ``LO_TPU_SHARD_HOST`` pin drops exactly the non-owned half to remote;
+4. crash (slow): a child process killed mid-partition-stream resumes at
+   the journaled offset, re-partitions the remaining range, and
+   converges bit-identically to the oracle with a green scrub.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.catalog import ingest
+from learningorchestra_tpu.catalog import readpipe
+from learningorchestra_tpu.catalog.ingest import ingest_csv_url, resume_ingest
+from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.config import Settings
+from learningorchestra_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    ingest.reset_counters()
+    yield
+    ingest.reset_counters()
+
+
+def _mk_cfg(tmp_path, tag: str, partitions: int = 0,
+            persist: bool = False) -> Settings:
+    cfg = Settings()
+    cfg.store_root = str(tmp_path / f"store_{tag}")
+    cfg.replica_root = ""
+    cfg.persist = persist
+    cfg.use_native_csv = False
+    cfg.ingest_chunk_rows = 64            # several chunks per partition
+    cfg.ingest_partitions = partitions
+    cfg.ingest_partition_min_bytes = 1    # force real splits on tiny CSVs
+    return cfg
+
+
+def _ingest(tmp_path, data: str, tag: str, partitions: int):
+    path = tmp_path / "src.csv"
+    if not path.exists():
+        path.write_bytes(data.encode())
+    cfg = _mk_cfg(tmp_path, tag, partitions)
+    store = DatasetStore(cfg)
+    store.create(tag, url=str(path))
+    ingest_csv_url(store, tag, str(path), cfg)
+    return store.get(tag)
+
+
+def _assert_identical(got, oracle):
+    assert got.metadata.finished and oracle.metadata.finished
+    assert got.metadata.fields == oracle.metadata.fields
+    assert got.num_rows == oracle.num_rows
+    for field in oracle.metadata.fields:
+        a, b = got.column(field), oracle.column(field)
+        assert a.dtype == b.dtype, field
+        np.testing.assert_array_equal(a, b, err_msg=field)
+
+
+# -- 1. parity ----------------------------------------------------------------
+
+@pytest.mark.parametrize("parts", [2, 3, 7])
+def test_partitioned_matches_serial_oracle(tmp_path, parts):
+    """Mixed plain/quoted rows, N partitions vs the serial path: same
+    fields, rows, dtypes, values — and a shard map with one entry per
+    effective partition summing to the row count."""
+    rows = []
+    for i in range(500):
+        if i % 7 == 3:
+            rows.append(f'{i},"q{i}, with comma",{i * 0.25}')
+        else:
+            rows.append(f"{i},plain{i},{i * 0.25}")
+    data = "a,b,c\n" + "\n".join(rows) + "\n"
+    got = _ingest(tmp_path, data, f"p{parts}", parts)
+    oracle = _ingest(tmp_path, data, "serial", 0)
+    _assert_identical(got, oracle)
+    smap = got.shard_map
+    assert smap is not None and oracle.shard_map is None
+    assert sum(p["rows"] for p in smap["partitions"]) == got.num_rows
+    starts = [p["row_start"] for p in smap["partitions"]]
+    assert starts == sorted(starts) and starts[0] == 0
+    assert ingest.counters_snapshot()["partition_ingests"] == 1
+
+
+def test_quoted_embedded_newlines_force_realign(tmp_path):
+    """Every record is a quoted field holding two embedded newlines —
+    most newlines in the byte stream are INSIDE quotes, so a speculative
+    parity-0 start anchored mid-partition is wrong and the coordinator's
+    offset-chain validation must discard and serially redo it. Parity
+    must survive; the realign counter proves the adversarial path ran."""
+    rows = [f'"L{i}\n{"pad" * (i % 5)}mid\nend",{i}' for i in range(300)]
+    data = "v,w\n" + "\n".join(rows) + "\n"
+    got = _ingest(tmp_path, data, "realign", 3)
+    oracle = _ingest(tmp_path, data, "serial", 0)
+    _assert_identical(got, oracle)
+    assert ingest.counters_snapshot()["partition_realigns"] >= 1
+
+
+def test_crlf_line_endings(tmp_path):
+    data = "a,b\r\n" + "".join(f"{i},{i * 3}\r\n" for i in range(400))
+    got = _ingest(tmp_path, data, "crlf", 3)
+    oracle = _ingest(tmp_path, data, "serial", 0)
+    _assert_identical(got, oracle)
+
+
+def test_record_spanning_the_split_point(tmp_path):
+    """One long unquoted record positioned across the 2-way byte
+    midpoint: the split lands mid-record and the boundary rule (worker i
+    streams to the first record end at/after its stop anchor, worker i+1
+    starts just past it) must hand the record to exactly one side."""
+    rows = [f"{i},s{i}" for i in range(100)]
+    rows.append(f"100,{'x' * 2000}")          # spans the midpoint
+    rows += [f"{i},s{i}" for i in range(101, 201)]
+    data = "a,b\n" + "\n".join(rows) + "\n"
+    got = _ingest(tmp_path, data, "span", 2)
+    oracle = _ingest(tmp_path, data, "serial", 0)
+    _assert_identical(got, oracle)
+
+
+def test_partition_with_zero_record_starts(tmp_path):
+    """A giant quoted record (embedded newlines) covering the entire
+    middle third: that partition contains NO true record start, so its
+    speculative start is necessarily bogus and the redo must collapse it
+    to zero rows without losing or duplicating the giant record."""
+    big = "y" * 2500 + "\n" + "z" * 2500
+    rows = [f"{i},t{i}" for i in range(10)]
+    rows.append(f'10,"{big}"')
+    rows += [f"{i},t{i}" for i in range(11, 21)]
+    data = "a,b\n" + "\n".join(rows) + "\n"
+    got = _ingest(tmp_path, data, "giant", 3)
+    oracle = _ingest(tmp_path, data, "serial", 0)
+    _assert_identical(got, oracle)
+    assert got.column("b")[10] == big
+
+
+# -- 2. default-off ------------------------------------------------------------
+
+def test_default_config_never_enters_partitioned_path(tmp_path, monkeypatch):
+    """ingest_partitions defaults to 0: the partitioned entry point must
+    not even be called — the serial path is untouched by default."""
+    def boom(*a, **k):
+        raise AssertionError("partitioned path entered with default cfg")
+
+    monkeypatch.setattr(ingest, "_run_partitioned_ingest", boom)
+    path = tmp_path / "src.csv"
+    path.write_text("a,b\n" + "".join(f"{i},{i}\n" for i in range(50)))
+    cfg = _mk_cfg(tmp_path, "def")
+    assert cfg.ingest_partitions == 0
+    store = DatasetStore(cfg)
+    store.create("d", url=str(path))
+    ingest_csv_url(store, "d", str(path), cfg)
+    assert store.get("d").num_rows == 50
+    assert store.get("d").shard_map is None
+
+
+def test_small_source_falls_back_to_serial(tmp_path):
+    """A source below the per-partition minimum serves serially (counted
+    as a fallback) and still lands the same rows."""
+    path = tmp_path / "src.csv"
+    path.write_text("a,b\n" + "".join(f"{i},{i}\n" for i in range(50)))
+    cfg = _mk_cfg(tmp_path, "small", partitions=4)
+    cfg.ingest_partition_min_bytes = 4 << 20   # default floor: 4 MiB
+    store = DatasetStore(cfg)
+    store.create("d", url=str(path))
+    ingest_csv_url(store, "d", str(path), cfg)
+    assert store.get("d").num_rows == 50
+    assert ingest.counters_snapshot()["partition_fallbacks"] >= 1
+
+
+# -- 3. placement --------------------------------------------------------------
+
+def _fixed_width_dataset(tmp_path, partitions: int):
+    """400 fixed-width rows: the byte split IS a row split, so the two
+    partitions own exactly rows [0,200) and [200,400)."""
+    data = "x,y\n" + "".join(f"{i:06d},{i % 5}\n" for i in range(400))
+    return _ingest(tmp_path, data, "place", partitions)
+
+
+def _plan_feed(cfg, ds):
+    from learningorchestra_tpu.ops import preprocess
+    from learningorchestra_tpu.parallel.mesh import local_mesh, shard_chunked
+
+    X, _y, _ff, _state = preprocess.design_matrix_streamed(ds, "y")
+    mesh = local_mesh(cfg)
+    readpipe.reset()
+    shard_chunked(mesh, X, prefetch=0)
+    return readpipe.shard_snapshot()
+
+
+def test_placement_is_host_local_on_aligned_feed(tmp_path):
+    """Acceptance gate: on the modeled pod topology (8 devices, hosts =
+    partition count, consecutive devices per host) every addressable
+    shard's rows fall inside its own host's partition — local-read
+    fraction ≥ 0.95 (here exactly 1.0)."""
+    ds = _fixed_width_dataset(tmp_path, 2)
+    assert [p["rows"] for p in ds.shard_map["partitions"]] == [200, 200]
+    snap = _plan_feed(_mk_cfg(tmp_path, "place"), ds)
+    total = snap["local_reads"] + snap["remote_reads"]
+    assert total == 400, snap
+    assert snap["local_reads"] / total >= 0.95, snap
+
+
+def test_shard_host_pin_reclassifies_reads(tmp_path, monkeypatch):
+    """LO_TPU_SHARD_HOST pins the planner's identity: host 0 owns only
+    the first partition, so exactly the other partition's rows plan
+    remote — the signal an operator uses to spot topology mismatch."""
+    ds = _fixed_width_dataset(tmp_path, 2)
+    monkeypatch.setenv("LO_TPU_SHARD_HOST", "0")
+    snap = _plan_feed(_mk_cfg(tmp_path, "place"), ds)
+    assert snap["local_reads"] == 200 and snap["remote_reads"] == 200, snap
+
+
+def test_unsharded_dataset_plans_no_remote_reads(tmp_path):
+    """No shard map (serial ingest) → placement is a no-op hint: nothing
+    classifies remote."""
+    data = "x,y\n" + "".join(f"{i:06d},{i % 5}\n" for i in range(400))
+    ds = _ingest(tmp_path, data, "serial", 0)
+    snap = _plan_feed(_mk_cfg(tmp_path, "serial"), ds)
+    assert snap["remote_reads"] == 0
+
+
+# -- 4. metrics ---------------------------------------------------------------
+
+def test_metrics_counters_and_prometheus_names(tmp_path):
+    from learningorchestra_tpu.utils import prometheus
+
+    _fixed_width_dataset(tmp_path, 2)
+    snap = ingest.counters_snapshot()
+    for key in ("partition_ingests", "partition_starts", "partition_bytes",
+                "partition_rows", "partition_realigns", "partition_resumes",
+                "partition_fallbacks"):
+        assert key in snap
+    assert snap["partition_ingests"] == 1
+    assert snap["partition_starts"] == 2
+    assert snap["partition_rows"] == 400
+    text = prometheus.render({"ingest": snap,
+                              "shard": readpipe.shard_snapshot()})
+    assert "lo_ingest_partition_ingests 1" in text
+    assert "lo_ingest_partition_rows 400" in text
+    assert "lo_shard_local_reads_total" in text
+    assert "lo_shard_remote_reads_total" in text
+
+
+# -- 5. replication over sharded datasets --------------------------------------
+
+def test_sharded_dataset_replicates_with_shard_map(tmp_path):
+    """The shard map rides the metadata doc through journal_sync: after
+    a drain the peer is fully caught up (no under-replication), the scrub
+    stays green, and a store recovered from disk still sees the map."""
+    from learningorchestra_tpu.catalog.replicate import ReplicaServer
+
+    peer = ReplicaServer(root=str(tmp_path / "peer"), port=0)
+    path = tmp_path / "src.csv"
+    path.write_text("a,b\n" + "".join(f"{i},{i * 2}\n" for i in range(2000)))
+    cfg = _mk_cfg(tmp_path, "rep", partitions=2, persist=True)
+    cfg.replica_peers = f"{peer.host}:{peer.port}"
+    store = DatasetStore(cfg)
+    try:
+        store.create("d", url=str(path))
+        ingest_csv_url(store, "d", str(path), cfg)
+        assert store.replication_drain(timeout_s=60.0)
+        snap = store.replication_snapshot()
+        assert snap["max_lag_bytes"] == 0 and not snap["under_replicated"]
+        assert store.scrub("d")["ok"]
+    finally:
+        store.stop_replication()
+        peer.stop()
+    store2 = DatasetStore(cfg)
+    try:
+        ds = store2.load("d")
+        assert ds.num_rows == 2000 and ds.shard_map is not None
+        assert sum(p["rows"] for p in ds.shard_map["partitions"]) == 2000
+        assert store2.scrub("d")["ok"]
+    finally:
+        store2.stop_replication()
+
+
+# -- 6. crash / resume chaos e2e (slow) ----------------------------------------
+
+_CHAOS_CHILD = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from learningorchestra_tpu.catalog.ingest import ingest_csv_url
+from learningorchestra_tpu.catalog.store import DatasetStore
+from learningorchestra_tpu.config import Settings
+
+root = sys.argv[1]
+cfg = Settings()
+cfg.store_root = os.path.join(root, "store")
+cfg.replica_root = ""
+cfg.persist = True
+cfg.use_native_csv = False
+cfg.ingest_chunk_rows = 2048
+cfg.ingest_commit_bytes = 0          # commit every block: early offsets
+cfg.ingest_partitions = 3
+cfg.ingest_partition_min_bytes = 1
+store = DatasetStore(cfg)
+src = os.path.join(root, "src.csv")
+store.create("d", url=src)
+ingest_csv_url(store, "d", src, cfg)
+"""
+
+
+@pytest.mark.slow
+def test_chaos_crash_mid_partition_resume_bit_identical(tmp_path):
+    """THE sharded-ingest chaos claim: kill a real child process
+    mid-partition-stream (failpoint ``ingest.partition.mid_stream``,
+    5th fetched chunk — well after the first journal commits), restart,
+    resume at the journaled offset re-partitioning the remaining range,
+    and converge bit-identically to the serial oracle with a green scrub
+    and a complete shard map."""
+    n = 200_000
+    root = str(tmp_path)
+    src = os.path.join(root, "src.csv")
+    with open(src, "w") as f:       # ~9.5 MB: ≥3 ranged fetches/partition
+        f.write("a,b,c\n")
+        for i in range(n):
+            f.write(f"{i},{i * 0.5},{'v' * 30}\n")
+    child = os.path.join(root, "child.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(child, "w") as f:
+        f.write(_CHAOS_CHILD.format(repo=repo))
+    env = dict(os.environ)
+    for var in ("LO_TPU_REPLICA_ROOT", "LO_TPU_REPLICA_PEERS",
+                "LO_TPU_REPLICA_PORT"):
+        env.pop(var, None)
+    env[failpoints.ENV_VAR] = "ingest.partition.mid_stream=crash:5"
+    proc = subprocess.run([sys.executable, child, root],
+                          capture_output=True, text=True, timeout=300,
+                          env=env)
+    assert proc.returncode == failpoints.CRASH_EXIT_CODE, \
+        proc.stderr[-2000:]
+
+    cfg = _mk_cfg(tmp_path, "", partitions=3, persist=True)
+    cfg.store_root = os.path.join(root, "store")   # the child's store
+    cfg.ingest_chunk_rows = 2048
+    cfg.ingest_commit_bytes = 0
+    store = DatasetStore(cfg)
+    store.load_all(resume_ingests=True)
+    assert "d" in store.resumable_ingests
+    ds = store.get("d")
+    assert ds.resume_offset and 0 < ds.num_rows < n
+    ingest.reset_counters()
+    resume_ingest(store, "d", cfg)
+    assert ingest.counters_snapshot()["partition_resumes"] == 1
+    ds = store.get("d")
+    assert ds.metadata.finished and ds.num_rows == n
+    assert store.scrub("d")["ok"]
+    smap = ds.shard_map
+    assert smap and sum(p["rows"] for p in smap["partitions"]) == n
+    assert smap["partitions"][0]["row_start"] == 0
+
+    oracle = _ingest(tmp_path, "", "oracle", 0)    # src.csv already on disk
+    _assert_identical(ds, oracle)

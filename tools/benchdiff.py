@@ -71,6 +71,13 @@ BUILTIN_TOLERANCES: List[Tuple[str, float]] = [
     # steadier than either wall-clock but still rides the same noise.
     ("*tune_bench*wall_s", 2.0),
     ("*tune_bench*speedup", 1.5),
+    # Sharded-ingest A/B (bench_outofcore): both walls ride a
+    # sleep-paced local HTTP link plus pandas parse on shared-rig CPU;
+    # the speedup ratio cancels most of it but still jitters. The hard
+    # ≥1.8x floor is asserted inside the bench itself — the tolerance
+    # only gates run-over-run drift.
+    ("*sharded_ingest*wall_s", 2.0),
+    ("*sharded_ingest*speedup", 0.5),
 ]
 
 
