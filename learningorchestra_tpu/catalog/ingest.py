@@ -89,7 +89,12 @@ def _http_session():
     funnel them through one shared connection-pool slot set, and
     Session's cookie/redirect internals are not safe under concurrent
     mutation. Thread-local sessions give each partition worker its own
-    pool at the cost of one TCP setup per (thread, host)."""
+    pool at the cost of one TCP setup per (thread, host). Short-lived
+    threads (partition/redo workers, the serial downloader) must call
+    ``_close_thread_session`` on exit — a thread-local pool on a dead
+    thread holds its sockets until GC, which leaks connections under
+    repeated ingests and trips warnings-as-errors test lanes with
+    unraisable ResourceWarnings."""
     s = getattr(_session_local, "session", None)
     if s is None:
         import requests
@@ -101,6 +106,15 @@ def _http_session():
         s.mount("https://", adapter)
         _session_local.session = s
     return s
+
+
+def _close_thread_session() -> None:
+    """Close and drop the calling thread's pooled session (no-op when the
+    thread never made an HTTP request)."""
+    s = getattr(_session_local, "session", None)
+    if s is not None:
+        _session_local.session = None
+        s.close()
 
 
 # --- ingest-plane counters (rendered as the /metrics `ingest` section) ---
@@ -211,6 +225,55 @@ class SourceChanged(ValueError):
     parsed from; resuming would corrupt the dataset."""
 
 
+class RangeUnsupported(RuntimeError):
+    """A ranged fetch that the caller requires to be honored came back
+    without 206 Partial Content. Partitioned ingest must not fall back to
+    skip-reading here: N workers each skip-reading from byte 0 downloads
+    the body N times concurrently — strictly worse than serial on exactly
+    the throttled links partitioning targets."""
+
+
+def _check_response_identity(resp, identity: dict, url: str) -> None:
+    """Re-validate one ranged response against the source identity captured
+    when the partitioned run began. Each partition worker issues its GET at
+    a different time, so a source that changes mid-ingest could otherwise
+    splice content from two versions across partitions — the offset-chain
+    check only catches that when record boundaries happen to misalign."""
+    for key, header in (("etag", "ETag"), ("last_modified", "Last-Modified")):
+        want = identity.get(key)
+        got = resp.headers.get(header)
+        if want is not None and got is not None and want != got:
+            raise SourceChanged(
+                f"source {key} changed mid-ingest at {url} "
+                f"({want!r} -> {got!r}); a partitioned fetch would splice "
+                "mismatched content")
+    want_len = identity.get("length")
+    total = _content_range_total(resp.headers.get("Content-Range"))
+    if want_len is not None and total is not None and total != want_len:
+        raise SourceChanged(
+            f"source length changed mid-ingest at {url} "
+            f"({want_len} -> {total}); a partitioned fetch would splice "
+            "mismatched content")
+
+
+def _check_file_identity(path: str, identity: dict) -> None:
+    """File-source analogue of ``_check_response_identity``: stat the path
+    again before each partition worker's read and compare against the
+    captured (length, mtime)."""
+    try:
+        st = os.stat(path)
+    except OSError as exc:
+        raise SourceChanged(
+            f"source file {path} vanished mid-ingest") from exc
+    for key, got in (("length", st.st_size), ("mtime", st.st_mtime)):
+        want = identity.get(key)
+        if want is not None and got != want:
+            raise SourceChanged(
+                f"source {key} changed mid-ingest at {path} "
+                f"({want!r} -> {got!r}); a partitioned read would splice "
+                "mismatched content")
+
+
 def _close_after(resp, it: Iterator[bytes]) -> Iterator[bytes]:
     """Stream ``it`` and close ``resp`` on exhaustion, error, or
     abandonment: a midstream ChunkedEncodingError (or a consumer that
@@ -224,11 +287,18 @@ def _close_after(resp, it: Iterator[bytes]) -> Iterator[bytes]:
 
 
 def _open_url_stream(url: str, timeout: float, offset: int = 0,
-                     chunk_bytes: int = 0) -> Iterator[bytes]:
+                     chunk_bytes: int = 0, require_range: bool = False,
+                     expect_identity: Optional[dict] = None
+                     ) -> Iterator[bytes]:
     """Yield byte chunks from a URL (http(s)://) or local file (file:// or
     bare path — used by tests and the bench harness), optionally starting
     at a byte offset (ingest resume). HTTP uses a Range request, falling
-    back to skip-reading when the server ignores it. ``chunk_bytes``
+    back to skip-reading when the server ignores it — unless
+    ``require_range`` is set (partition workers), in which case a
+    non-206 answer to a nonzero-offset request raises RangeUnsupported
+    instead of silently re-downloading the whole body. ``expect_identity``
+    re-validates the response (or file stat) against a previously captured
+    source identity, raising SourceChanged on mismatch. ``chunk_bytes``
     overrides the 1 MiB default chunk size — the partitioned header sniff
     reads small chunks so it isn't charged a megabyte of link time for
     one record."""
@@ -254,6 +324,10 @@ def _open_url_stream(url: str, timeout: float, offset: int = 0,
             if total is not None and total == offset:
                 return iter(())             # every byte already committed
             if total is None:
+                if require_range:
+                    raise RangeUnsupported(
+                        f"416 without a Content-Range total for ranged "
+                        f"request at byte {offset} of {url}")
                 # Can't tell from the 416: re-fetch in full and skip.
                 resp = _http_session().get(
                     url, stream=True, timeout=timeout,
@@ -271,6 +345,12 @@ def _open_url_stream(url: str, timeout: float, offset: int = 0,
                 "since the interrupted ingest")
         try:
             resp.raise_for_status()
+            if expect_identity:
+                _check_response_identity(resp, expect_identity, url)
+            if offset and require_range and resp.status_code != 206:
+                raise RangeUnsupported(
+                    f"server ignored Range request at byte {offset} of "
+                    f"{url} (HTTP {resp.status_code}, expected 206)")
         except Exception:
             resp.close()
             raise
@@ -279,6 +359,8 @@ def _open_url_stream(url: str, timeout: float, offset: int = 0,
             it = _skip_bytes(it, offset)
         return _close_after(resp, it)
     path = url[len("file://"):] if url.startswith("file://") else url
+    if expect_identity:
+        _check_file_identity(path, expect_identity)
 
     def file_chunks() -> Iterator[bytes]:
         with open(path, "rb") as f:
@@ -453,6 +535,8 @@ def _run_ingest(store: DatasetStore, name: str, url: str, cfg,
             _put(None)
         except Exception as exc:  # noqa: BLE001 — forwarded to consumer
             _put(exc)
+        finally:
+            _close_thread_session()
 
     # thread-lifecycle: owner=_run_ingest; exits when the stream is
     # drained, the consumer stops (_put returns False after close), or
@@ -686,7 +770,8 @@ def _parsed_rows(parsed) -> int:
 
 def _partition_worker(url: str, cfg, begin: int, stop_anchor: Optional[int],
                       length: int, fields: List[str], exact_start: bool,
-                      out_q: "queue.Queue", cancel: threading.Event) -> None:
+                      out_q: "queue.Queue", cancel: threading.Event,
+                      expect_identity: Optional[dict] = None) -> None:
     """Fetch + record-align + parse one byte partition.
 
     Emits, in order: ``("start", abs_off)`` — the absolute offset of the
@@ -701,20 +786,22 @@ def _partition_worker(url: str, cfg, begin: int, stop_anchor: Optional[int],
     last partition (``stop_anchor is None``) runs to EOF, torn final
     record included.
     """
+    def put(item) -> bool:
+        while not cancel.is_set():
+            try:
+                out_q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     try:
         failpoints.fire(FP_PARTITION_PRE_CLAIM)
 
-        def put(item) -> bool:
-            while not cancel.is_set():
-                try:
-                    out_q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
         anchor = begin if exact_start else begin - 1
-        stream = _open_url_stream(url, cfg.download_timeout, offset=anchor)
+        stream = _open_url_stream(url, cfg.download_timeout, offset=anchor,
+                                  require_range=True,
+                                  expect_identity=expect_identity)
         try:
             buf = bytearray()
             base = anchor
@@ -859,10 +946,16 @@ def _partition_worker(url: str, cfg, begin: int, stop_anchor: Optional[int],
             if close:
                 close()
     except Exception as exc:  # noqa: BLE001 — forwarded to coordinator
-        try:
-            out_q.put(("error", exc), timeout=1.0)
-        except queue.Full:
-            pass
+        # The error is a TERMINAL item: the coordinator blocks on this
+        # queue with no timeout, so dropping it (e.g. a put with a short
+        # timeout against a full queue — routine while the coordinator
+        # is still draining an earlier partition) would hang the ingest
+        # forever. Deliver with the same cancellation-aware retry loop
+        # blocks use: either the coordinator drains to it, or teardown
+        # sets ``cancel`` and the put bails.
+        put(("error", exc))
+    finally:
+        _close_thread_session()
 
 
 def _drain_worker(t: threading.Thread, wq: "queue.Queue") -> None:
@@ -883,14 +976,53 @@ def _drain_worker(t: threading.Thread, wq: "queue.Queue") -> None:
             break
 
 
-def _fetch_header(url: str, cfg):
+def _next_item(q_in: "queue.Queue", worker: threading.Thread):
+    """Blocking get that cannot hang on a dead producer. Workers deliver
+    their terminal item ("done"/"error") with a blocking put, so this
+    should never trigger — but a daemon thread can still die uncleanly
+    (interpreter teardown, a failpoint crash in a sibling), and the
+    coordinator must fail the job rather than block forever."""
+    while True:
+        try:
+            return q_in.get(timeout=1.0)
+        except queue.Empty:
+            if not worker.is_alive():
+                try:
+                    return q_in.get_nowait()
+                except queue.Empty:
+                    raise RuntimeError(
+                        f"partition worker {worker.name} died without a "
+                        "terminal queue item") from None
+
+
+def _probe_range_support(url: str, timeout: float, offset: int) -> bool:
+    """One-byte ranged GET before launching partition workers: a server
+    that ignores Range (200 instead of 206) would otherwise make every
+    worker skip-read the body from byte 0 — N concurrent full downloads,
+    strictly worse than serial on exactly the throttled links the feature
+    targets — so such sources stay on the serial path."""
+    try:
+        resp = _http_session().get(
+            url, stream=True, timeout=timeout,
+            headers={"Accept-Encoding": "identity",
+                     "Range": f"bytes={offset}-{offset}"})
+        try:
+            return resp.status_code == 206
+        finally:
+            resp.close()
+    except Exception:  # noqa: BLE001 — a failing probe just means serial
+        return False
+
+
+def _fetch_header(url: str, cfg, expect_identity: Optional[dict] = None):
     """Fetch just the header record of a fresh partitioned ingest:
     ``(fields, body_start)``, or None when the source has no complete
     header (empty / unbalanced — the serial path owns those edges). Small
     chunks: on a throttled link a 1 MiB first read would serialize a
     megabyte of wait in front of every partition worker."""
     stream = _open_url_stream(url, cfg.download_timeout,
-                              chunk_bytes=64 << 10)
+                              chunk_bytes=64 << 10,
+                              expect_identity=expect_identity)
     buf = bytearray()
     nl, scanned, hq = -1, 0, 0
     first = True
@@ -940,7 +1072,7 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
         pre_rows = ds.num_rows
         bump("partition_resumes")
     else:
-        got = _fetch_header(url, cfg)
+        got = _fetch_header(url, cfg, expect_identity=identity)
         if got is None:
             bump("partition_fallbacks")
             return False
@@ -950,6 +1082,10 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
     min_bytes = getattr(cfg, "ingest_partition_min_bytes", 0) or 0
     ranges = _partition_ranges(body_start, length, n_parts, min_bytes)
     if len(ranges) <= 1:
+        bump("partition_fallbacks")
+        return False
+    if url.startswith(("http://", "https://")) and not _probe_range_support(
+            url, cfg.download_timeout, body_start):
         bump("partition_fallbacks")
         return False
 
@@ -966,7 +1102,8 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
         # coordinator, never left to die uncaught; daemon.
         t = threading.Thread(
             target=_partition_worker,
-            args=(url, cfg, b, nxt, length, fields, i == 0, wq, wc),
+            args=(url, cfg, b, nxt, length, fields, i == 0, wq, wc,
+                  identity),
             daemon=True, name=f"lo-ingest-p{i}")
         t.start()
         bump("partition_starts")
@@ -979,14 +1116,17 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
     commit_every = cfg.ingest_commit_bytes
     redo: list = []              # (thread, queue, event) realign re-runs
 
-    def consume(q_in: "queue.Queue") -> Tuple[int, int]:
+    appended = False             # any block landed in the dataset yet?
+
+    def consume(q_in: "queue.Queue", worker: threading.Thread
+                ) -> Tuple[int, int]:
         """Drain one validated partition in order, appending every block
         and batching commits exactly like the serial committer; returns
         (rows, stop_abs)."""
-        nonlocal commit_fut, pending_bytes
+        nonlocal commit_fut, pending_bytes, appended
         rows = 0
         while True:
-            item = q_in.get()
+            item = _next_item(q_in, worker)
             kind = item[0]
             if kind == "error":
                 raise item[1]
@@ -995,6 +1135,7 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
             _, parsed, src_end = item
             rows += _parsed_rows(parsed)
             pending_bytes += _append_parsed(ds, parsed, src_end)
+            appended = True
             if cfg.persist and (not commit_every
                                 or pending_bytes >= commit_every):
                 if commit_fut is not None:
@@ -1005,14 +1146,15 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
     part_rows: List[int] = []
     part_spans: List[Tuple[int, int]] = []
     expected = body_start        # the offset-chain invariant
+    range_fallback = False
     try:
         for i, (t, wq, wc, nxt) in enumerate(workers):
-            item = wq.get()
+            item = _next_item(wq, t)
             if item[0] == "error":
                 raise item[1]
             start_abs = item[1]
             if start_abs == expected:
-                rows_i, stop = consume(wq)
+                rows_i, stop = consume(wq, t)
             else:
                 # Misaligned speculation: the anchor fell inside a quoted
                 # field, so the worker's assumed parity — and every cut
@@ -1037,14 +1179,14 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
                 rt = threading.Thread(
                     target=_partition_worker,
                     args=(url, cfg, expected, nxt, length, fields, True,
-                          rq, rc),
+                          rq, rc, identity),
                     daemon=True, name=f"lo-ingest-r{i}")
                 rt.start()
                 redo.append((rt, rq, rc))
-                first = rq.get()
+                first = _next_item(rq, rt)
                 if first[0] == "error":
                     raise first[1]
-                rows_i, stop = consume(rq)
+                rows_i, stop = consume(rq, rt)
             part_rows.append(rows_i)
             part_spans.append((expected, stop))
             expected = stop
@@ -1053,6 +1195,15 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
             commit_fut = None
         if cfg.persist:
             store.save(name)
+    except RangeUnsupported:
+        # The probe said ranges work but a worker's fetch came back
+        # non-206 anyway (inconsistent server / mid-run CDN change).
+        # Before anything landed in the dataset the serial path can still
+        # take over cleanly; after that, re-running from byte 0 would
+        # duplicate rows, so fail the job (resume retries it).
+        if appended:
+            raise
+        range_fallback = True
     finally:
         for t, wq, wc, _n in workers:
             wc.set()
@@ -1063,6 +1214,9 @@ def _run_partitioned_ingest(store: DatasetStore, name: str, url: str, cfg,
         for rt, rq, rc in redo:
             _drain_worker(rt, rq)
         commit_pool.shutdown(wait=True)
+    if range_fallback:
+        bump("partition_fallbacks")
+        return False
 
     total_rows = sum(part_rows)
     parts_meta = []

@@ -18,8 +18,11 @@ Four layers of proof for the sharded ingest plane (ISSUE 20):
 """
 
 import os
+import queue
 import subprocess
 import sys
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -291,7 +294,175 @@ def test_sharded_dataset_replicates_with_shard_map(tmp_path):
         store2.stop_replication()
 
 
-# -- 6. crash / resume chaos e2e (slow) ----------------------------------------
+# -- 6. HTTP range handling ----------------------------------------------------
+
+def _make_range_handler(csv_bytes: bytes, support_range: bool = True,
+                        etag_for_range: str = '"v1"'):
+    """Handler factory for the partitioned-HTTP tests: HEAD advertises
+    length + ETag "v1"; GET honors Range with 206 (or ignores it when
+    ``support_range`` is False, answering 200 + full body like a server
+    without range support); ranged responses carry ``etag_for_range`` so a
+    test can simulate a source that changes between the identity capture
+    and the partition fetches."""
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        full_gets = 0           # 200-with-full-body responses served
+
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(csv_bytes)))
+            self.send_header("ETag", '"v1"')
+            self.end_headers()
+
+        def do_GET(self):
+            rng = self.headers.get("Range")
+            try:
+                if rng and support_range:
+                    spec = rng.split("=", 1)[1]
+                    lo_s, _, hi_s = spec.partition("-")
+                    lo = int(lo_s)
+                    hi = min(int(hi_s) if hi_s else len(csv_bytes) - 1,
+                             len(csv_bytes) - 1)
+                    body = csv_bytes[lo:hi + 1]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range", f"bytes {lo}-{hi}/{len(csv_bytes)}")
+                    self.send_header("ETag", etag_for_range)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    type(self).full_gets += 1
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(csv_bytes)))
+                    self.send_header("ETag", '"v1"')
+                    self.end_headers()
+                    self.wfile.write(csv_bytes)
+            except OSError:
+                pass            # client closed a streamed fetch early
+
+    return Handler
+
+
+@pytest.fixture()
+def http_source():
+    """Start a server for a given handler; yields a starter returning the
+    source URL, and tears the server down afterwards."""
+    from http.server import ThreadingHTTPServer
+
+    servers = []
+
+    def start(handler) -> str:
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return f"http://127.0.0.1:{srv.server_address[1]}/src.csv"
+
+    yield start
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _http_rows(n: int = 500) -> str:
+    return "a,b,c\n" + "".join(f"{i},name{i},{i * 0.25}\n" for i in range(n))
+
+
+def test_partitioned_http_ingest_closes_worker_sessions(
+        tmp_path, http_source, monkeypatch):
+    """Happy path over a Range-supporting server: partitioned HTTP ingest
+    matches the serial oracle — and every partition worker closes its
+    thread-local requests.Session on exit (a dead thread's pool would
+    otherwise strand sockets until GC)."""
+    import requests
+
+    closed = []
+    orig_close = requests.Session.close
+
+    def spy_close(self):
+        closed.append(self)
+        orig_close(self)
+
+    monkeypatch.setattr(requests.Session, "close", spy_close)
+    data = _http_rows()
+    url = http_source(_make_range_handler(data.encode()))
+    cfg = _mk_cfg(tmp_path, "http", partitions=2)
+    store = DatasetStore(cfg)
+    store.create("h", url=url)
+    ingest_csv_url(store, "h", url, cfg)
+    got = store.get("h")
+    oracle = _ingest(tmp_path, data, "serial", 0)
+    _assert_identical(got, oracle)
+    assert got.shard_map is not None
+    assert ingest.counters_snapshot()["partition_ingests"] == 1
+    assert len(closed) >= 2     # one per partition worker thread
+
+
+def test_range_ignoring_server_falls_back_to_serial(tmp_path, http_source):
+    """A server that answers 200 to ranged requests must route the
+    partitioned request to the serial path (one body download), not have
+    every worker skip-read the full body concurrently — the probe detects
+    it before any worker launches."""
+    data = _http_rows()
+    handler = _make_range_handler(data.encode(), support_range=False)
+    url = http_source(handler)
+    cfg = _mk_cfg(tmp_path, "norange", partitions=3)
+    store = DatasetStore(cfg)
+    store.create("h", url=url)
+    ingest_csv_url(store, "h", url, cfg)
+    ds = store.get("h")
+    assert ds.num_rows == 500 and ds.shard_map is None
+    snap = ingest.counters_snapshot()
+    assert snap["partition_ingests"] == 0
+    assert snap["partition_fallbacks"] >= 1
+    # header sniff + probe + one serial body: never N concurrent copies
+    assert handler.full_gets <= 3
+
+
+def test_source_changed_between_identity_and_partition_fetch(
+        tmp_path, http_source):
+    """Each ranged response is re-validated against the identity captured
+    up front: a source whose ETag differs at partition-fetch time fails
+    the ingest with SourceChanged instead of splicing two versions."""
+    data = _http_rows()
+    url = http_source(_make_range_handler(data.encode(),
+                                          etag_for_range='"v2"'))
+    cfg = _mk_cfg(tmp_path, "etag", partitions=2)
+    store = DatasetStore(cfg)
+    store.create("h", url=url)
+    with pytest.raises(ingest.SourceChanged):
+        ingest_csv_url(store, "h", url, cfg)
+
+
+def test_worker_error_is_delivered_even_when_queue_full(tmp_path):
+    """A partition worker that dies while its bounded queue is full (the
+    coordinator is still draining an earlier partition) must still deliver
+    its terminal error item — dropping it would leave the coordinator
+    blocked on the queue forever."""
+    cfg = _mk_cfg(tmp_path, "err", partitions=2)
+    q: "queue.Queue" = queue.Queue(maxsize=1)
+    q.put(("block", {}, 0))            # queue full, like a prefetch backlog
+    cancel = threading.Event()
+    t = threading.Thread(
+        target=ingest._partition_worker,
+        args=(str(tmp_path / "missing.csv"), cfg, 10, None, 100, ["a"],
+              False, q, cancel),
+        daemon=True)
+    t.start()
+    time.sleep(1.5)     # regression: a timed put would have given up by now
+    assert q.get(timeout=5)[0] == "block"
+    item = q.get(timeout=10)
+    assert item[0] == "error"
+    assert isinstance(item[1], OSError)
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+# -- 7. crash / resume chaos e2e (slow) ----------------------------------------
 
 _CHAOS_CHILD = """
 import os, sys
